@@ -1,0 +1,11 @@
+"""tensor2robot_tpu: a TPU-native robot-learning framework (JAX/XLA/pjit/Pallas).
+
+A ground-up redesign with the capabilities of Google's Tensor2Robot: a
+declarative tensor-spec system that auto-generates input pipelines, runtime
+validation, and serving signatures; a model abstraction training data-parallel
+over TPU meshes in native bfloat16; async checkpointing and spec-carrying
+exports; polling predictors and robot-control policies; MAML meta-learning;
+and a vision layer library with Pallas TPU kernels.
+"""
+
+__version__ = '0.1.0'

@@ -1,0 +1,52 @@
+"""Spec core: declarative tensor specifications and their algebra.
+
+The spec system is the framework's backbone (parity with the reference's
+``utils/tensorspec_utils.py``): models declare feature/label requirements as
+SpecStructs of TensorSpecs, and the data pipeline, preprocessors, trainer,
+exporters, and predictors all derive their behavior from those declarations.
+"""
+
+from tensor2robot_tpu.specs.tensor_spec import (
+    TensorSpec,
+    ExtendedTensorSpec,
+    bfloat16,
+    canonical_dtype,
+    dtype_name,
+    dtype_enum,
+)
+from tensor2robot_tpu.specs.struct import SpecStruct, TensorSpecStruct
+from tensor2robot_tpu.specs.algebra import (
+    add_sequence_length_specs,
+    assert_equal_spec_maps,
+    assert_required,
+    assert_valid_spec_structure,
+    cast_to_dtype,
+    copy_tensorspec,
+    dataset_keys,
+    filter_required_flat_tensor_spec,
+    filter_spec_structure_by_dataset,
+    flatten_spec_structure,
+    is_encoded_image_spec,
+    maybe_ignore_batch,
+    pack_flat_sequence_to_spec_structure,
+    pad_or_clip_tensor_to_spec_shape,
+    replace_dtype,
+    validate_and_flatten,
+    validate_and_pack,
+)
+from tensor2robot_tpu.specs.generators import (
+    make_constant_numpy,
+    make_placeholders,
+    make_random_numpy,
+    map_feed_dict,
+)
+from tensor2robot_tpu.specs.assets import (
+    EXTRA_ASSETS_DIRECTORY,
+    T2R_ASSETS_FILENAME,
+    load_global_step_from_file,
+    load_input_spec_from_file,
+    load_t2r_assets_from_file,
+    write_global_step_to_file,
+    write_input_spec_to_file,
+    write_t2r_assets_to_file,
+)

@@ -1,0 +1,283 @@
+"""Spec algebra: flatten / pack / validate / filter / transform.
+
+Parity target: the spec-manipulation layer of the reference
+(/root/reference/utils/tensorspec_utils.py:685-1677). These functions are the
+boundary-validation machinery the whole framework hangs off: the data pipeline
+validates parsed batches against model in-specs, preprocessors validate both
+sides, and the trainer validates at trace time (where validation is free since
+JAX shapes are static).
+
+All functions accept arbitrary nests (dict / namedtuple / SpecStruct) and
+return :class:`SpecStruct`.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.specs.struct import SpecStruct, _is_namedtuple
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec, canonical_dtype
+
+
+def flatten_spec_structure(spec_or_tensors) -> SpecStruct:
+  """Flattens any nest into a flat-path SpecStruct (ref: :1298)."""
+  if spec_or_tensors is None:
+    return SpecStruct()
+  if isinstance(spec_or_tensors, SpecStruct):
+    flat = SpecStruct()
+    for k in spec_or_tensors:
+      v = spec_or_tensors[k]
+      if not isinstance(v, SpecStruct):
+        flat[k] = v
+    return flat
+  if isinstance(spec_or_tensors, Mapping) or _is_namedtuple(spec_or_tensors):
+    return SpecStruct(spec_or_tensors)
+  # A single leaf (spec/array): wrap under its name or a default key.
+  name = getattr(spec_or_tensors, 'name', None) or 'value'
+  return SpecStruct(**{name: spec_or_tensors})
+
+
+def assert_valid_spec_structure(spec_structure) -> None:
+  """All leaves are TensorSpecs and equal spec-names imply equal specs (ref: :1458)."""
+  flat = flatten_spec_structure(spec_structure)
+  by_name = {}
+  for key in flat:
+    spec = flat[key]
+    if not isinstance(spec, TensorSpec):
+      raise ValueError(
+          'Invalid spec structure: {} -> {} is not a TensorSpec.'.format(
+              key, type(spec)))
+    if spec.name is None:
+      continue
+    seen = by_name.get(spec.name)
+    if seen is not None and seen != spec:
+      raise ValueError(
+          'Duplicate spec name {!r} with conflicting definitions: {} vs {}.'
+          .format(spec.name, seen, spec))
+    by_name[spec.name] = spec
+
+
+def assert_equal_spec_maps(expected, actual) -> None:
+  expected, actual = flatten_spec_structure(expected), flatten_spec_structure(actual)
+  if set(expected.keys()) != set(actual.keys()):
+    raise ValueError('Spec key sets differ: {} vs {}'.format(
+        sorted(expected.keys()), sorted(actual.keys())))
+  for key in expected:
+    if expected[key] != actual[key]:
+      raise ValueError('Spec {} differs: {} vs {}'.format(
+          key, expected[key], actual[key]))
+
+
+def maybe_ignore_batch(shape, ignore_batch: bool):
+  """Strips the leading (batch) dim for validation (ref: :1067)."""
+  if not ignore_batch:
+    return tuple(shape)
+  if len(shape) == 0:
+    raise ValueError('Cannot ignore batch dimension of a scalar tensor.')
+  return tuple(shape)[1:]
+
+
+def _leaf_shape_dtype(value):
+  if hasattr(value, 'shape') and hasattr(value, 'dtype'):
+    return tuple(value.shape), canonical_dtype(value.dtype)
+  if isinstance(value, (bytes, str)):
+    return (), np.dtype(object)
+  arr = np.asarray(value)
+  if arr.dtype.kind in ('U', 'S', 'O'):
+    return tuple(arr.shape), np.dtype(object)
+  return tuple(arr.shape), arr.dtype
+
+
+def _validate_leaf(key: str, spec: TensorSpec, value, ignore_batch: bool) -> None:
+  shape, dtype = _leaf_shape_dtype(value)
+  shape = maybe_ignore_batch(shape, ignore_batch)
+  spec_shape = spec.shape
+  if spec.is_sequence and len(shape) == len(spec_shape) + 1:
+    # Ragged time major dim (after batch strip) is allowed for sequence specs.
+    shape = shape[1:]
+  if dtype != spec.dtype:
+    raise ValueError(
+        'Tensor {!r} dtype {} does not match spec {}.'.format(
+            key, dtype, spec))
+  if len(shape) != len(spec_shape):
+    raise ValueError(
+        'Tensor {!r} rank {} (shape {}) does not match spec {}'
+        ' (ignore_batch={}).'.format(key, len(shape), shape, spec, ignore_batch))
+  for mine, theirs in zip(spec_shape, shape):
+    if mine is not None and theirs is not None and int(mine) != int(theirs):
+      raise ValueError(
+          'Tensor {!r} shape {} incompatible with spec {}.'.format(
+              key, shape, spec))
+
+
+def validate_and_flatten(spec_structure, tensors,
+                         ignore_batch: bool = False) -> SpecStruct:
+  """Validates tensors against specs; returns flat tensors keyed by spec paths.
+
+  Required specs must be present; optional specs missing from ``tensors`` are
+  dropped silently (ref: validate_and_flatten :1205).
+  """
+  spec_flat = flatten_spec_structure(spec_structure)
+  tensor_flat = flatten_spec_structure(tensors)
+  out = SpecStruct()
+  for key in spec_flat:
+    spec = spec_flat[key]
+    if key not in tensor_flat:
+      if spec.is_optional:
+        continue
+      raise ValueError(
+          'Required tensor {!r} missing; available: {}.'.format(
+              key, sorted(tensor_flat.keys())))
+    value = tensor_flat[key]
+    _validate_leaf(key, spec, value, ignore_batch)
+    out[key] = value
+  return out
+
+
+def pack_flat_sequence_to_spec_structure(spec_structure, flat_tensors) -> SpecStruct:
+  """Packs flat tensors into the hierarchy of ``spec_structure`` (ref: :1343).
+
+  Optional specs with no tensor are dropped.
+  """
+  spec_flat = flatten_spec_structure(spec_structure)
+  tensor_flat = flatten_spec_structure(flat_tensors)
+  packed = SpecStruct()
+  for key in spec_flat:
+    spec = spec_flat[key]
+    if key not in tensor_flat:
+      if getattr(spec, 'is_optional', False):
+        continue
+      raise ValueError(
+          'Cannot pack: required key {!r} missing from tensors {}.'.format(
+              key, sorted(tensor_flat.keys())))
+    packed[key] = tensor_flat[key]
+  return packed
+
+
+def validate_and_pack(spec_structure, tensors,
+                      ignore_batch: bool = False) -> SpecStruct:
+  """validate_and_flatten + pack (ref: :1239)."""
+  flat = validate_and_flatten(spec_structure, tensors, ignore_batch)
+  return pack_flat_sequence_to_spec_structure(spec_structure, flat)
+
+
+def assert_required(spec_structure, tensors, ignore_batch: bool = False) -> None:
+  """Raises unless every required spec has a valid tensor (ref: :1164)."""
+  validate_and_flatten(spec_structure, tensors, ignore_batch)
+
+
+def filter_required_flat_tensor_spec(spec_structure) -> SpecStruct:
+  """Keeps only non-optional specs (ref: :1527)."""
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key in flat:
+    if not flat[key].is_optional:
+      out[key] = flat[key]
+  return out
+
+
+def filter_spec_structure_by_dataset(spec_structure, dataset_key: str) -> SpecStruct:
+  """Keeps specs belonging to ``dataset_key`` (ref: :1286)."""
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key in flat:
+    if flat[key].dataset_key == dataset_key:
+      out[key] = flat[key]
+  return out
+
+
+def dataset_keys(spec_structure):
+  """Sorted unique dataset keys present in the structure."""
+  flat = flatten_spec_structure(spec_structure)
+  return sorted({flat[key].dataset_key for key in flat})
+
+
+def copy_tensorspec(spec_structure, batch_size: Optional[int] = None,
+                    prefix: str = '') -> SpecStruct:
+  """Deep-copies specs, optionally prepending batch dim + name prefix (ref: :750)."""
+  flat = flatten_spec_structure(spec_structure)
+  assert_valid_spec_structure(flat)
+  out = SpecStruct()
+  for key in flat:
+    spec = flat[key]
+    name = spec.name
+    if prefix and name is not None:
+      name = prefix + '/' + name
+    out[key] = TensorSpec.from_spec(spec, name=name, batch_size=batch_size)
+  return out
+
+
+def add_sequence_length_specs(spec_structure) -> SpecStruct:
+  """Adds an int64 ``<key>_length`` spec for every sequence spec (ref: :1275)."""
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key in flat:
+    out[key] = flat[key]
+    if flat[key].is_sequence:
+      out[key + '_length'] = TensorSpec(
+          shape=(), dtype=np.int64,
+          name=(flat[key].name or key.replace('/', '_')) + '_length')
+  return out
+
+
+def replace_dtype(spec_structure, from_dtype, to_dtype) -> SpecStruct:
+  """Re-types all specs of ``from_dtype`` (ref: :685)."""
+  from_dtype = canonical_dtype(from_dtype)
+  to_dtype = canonical_dtype(to_dtype)
+  flat = flatten_spec_structure(spec_structure)
+  out = SpecStruct()
+  for key in flat:
+    spec = flat[key]
+    if spec.dtype == from_dtype:
+      spec = TensorSpec.from_spec(spec, dtype=to_dtype)
+    out[key] = spec
+  return out
+
+
+def cast_to_dtype(tensors, from_dtype, to_dtype):
+  """Casts every array of ``from_dtype`` in a nest to ``to_dtype`` (ref: :708,:733).
+
+  Works on numpy and jax arrays; under jit this is a free element-type change
+  that XLA fuses into neighbors.
+  """
+  import jax.numpy as jnp  # local: keep module import light for data workers
+  from_dtype = canonical_dtype(from_dtype)
+  flat = flatten_spec_structure(tensors)
+  out = SpecStruct()
+  for key in flat:
+    value = flat[key]
+    vdtype = getattr(value, 'dtype', None)
+    if vdtype is not None and canonical_dtype(vdtype) == from_dtype:
+      if isinstance(value, np.ndarray):
+        value = value.astype(to_dtype)
+      else:
+        value = jnp.asarray(value).astype(to_dtype)
+    out[key] = value
+  return out
+
+
+def pad_or_clip_tensor_to_spec_shape(tensor, spec: TensorSpec):
+  """Pads (with varlen_default_value) or clips dim-0 to spec.shape[0] (ref: :1626)."""
+  target = spec.shape[0]
+  if target is None:
+    return tensor
+  arr = np.asarray(tensor) if isinstance(tensor, (list, tuple)) else tensor
+  length = arr.shape[0]
+  if length >= target:
+    return arr[:target]
+  pad_value = spec.varlen_default_value
+  pad_value = 0 if pad_value is None else pad_value
+  pad_shape = (int(target) - length,) + tuple(arr.shape[1:])
+  if isinstance(arr, np.ndarray):
+    pad = np.full(pad_shape, pad_value, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+  import jax.numpy as jnp
+  pad = jnp.full(pad_shape, pad_value, dtype=arr.dtype)
+  return jnp.concatenate([arr, pad], axis=0)
+
+
+def is_encoded_image_spec(spec: TensorSpec) -> bool:
+  return spec.is_encoded_image

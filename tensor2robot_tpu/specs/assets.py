@@ -1,0 +1,279 @@
+"""t2r_assets serialization: the spec contract shipped inside every export.
+
+Every exported model directory contains ``assets.extra/t2r_assets.pbtxt``
+describing the feature/label specs and global step, so robot-side predictors
+can reconstruct feeds without the model's Python class. This module reads and
+writes that file in protobuf text format, wire/text-compatible with the
+reference schema (/root/reference/proto/t2r.proto:19-44 — messages
+ExtendedTensorSpec / TensorSpecStruct / T2RAssets) without requiring protoc:
+the grammar of the fixed schema is small enough to emit and parse directly.
+
+A JSON twin (``t2r_assets.json``) is also written for tooling convenience.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Optional, Tuple
+
+from tensor2robot_tpu.specs.algebra import flatten_spec_structure
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+T2R_ASSETS_FILENAME = 't2r_assets.pbtxt'
+T2R_ASSETS_JSON_FILENAME = 't2r_assets.json'
+EXTRA_ASSETS_DIRECTORY = 'assets.extra'
+GLOBAL_STEP_FILENAME = 'global_step.txt'
+
+
+# -- pbtxt emission ----------------------------------------------------------
+
+def _emit_scalar(value) -> str:
+  if isinstance(value, bool):
+    return 'true' if value else 'false'
+  if isinstance(value, str):
+    escaped = value.replace('\\', '\\\\').replace('"', '\\"')
+    return '"{}"'.format(escaped)
+  if isinstance(value, float):
+    return repr(value)
+  return str(int(value))
+
+
+def _emit_message(fields, indent: int = 0) -> str:
+  """fields: list of (name, value) where value is scalar, list-of-scalars, or dict."""
+  pad = '  ' * indent
+  lines = []
+  for name, value in fields:
+    if isinstance(value, dict):
+      lines.append('{}{} {{'.format(pad, name))
+      lines.append(_emit_message(list(value.items()), indent + 1))
+      lines.append('{}}}'.format(pad))
+    elif isinstance(value, list):
+      for item in value:
+        if isinstance(item, dict):
+          lines.append('{}{} {{'.format(pad, name))
+          lines.append(_emit_message(list(item.items()), indent + 1))
+          lines.append('{}}}'.format(pad))
+        else:
+          lines.append('{}{}: {}'.format(pad, name, _emit_scalar(item)))
+    else:
+      lines.append('{}{}: {}'.format(pad, name, _emit_scalar(value)))
+  return '\n'.join(lines)
+
+
+def _spec_struct_to_fields(spec_structure) -> dict:
+  flat = flatten_spec_structure(spec_structure)
+  entries = []
+  for key in flat:
+    spec = flat[key]
+    value = collections.OrderedDict()
+    d = spec.to_dict()
+    # Strictly the reference proto's fields 1-8 (t2r.proto:19-30) so the
+    # reference stack's text_format.Parse accepts our files. is_sequence is
+    # not part of that schema; it round-trips via the JSON twin instead.
+    for field in ('shape', 'dtype', 'name', 'is_optional', 'is_extracted',
+                  'data_format', 'dataset_key', 'varlen_default_value'):
+      if field in d:
+        value[field] = d[field]
+    entries.append(collections.OrderedDict([('key', key), ('value', value)]))
+  return {'key_value': entries}
+
+
+def specs_to_pbtxt(feature_spec, label_spec,
+                   global_step: Optional[int] = None) -> str:
+  fields = []
+  if feature_spec is not None:
+    fields.append(('feature_spec', _spec_struct_to_fields(feature_spec)))
+  if label_spec is not None:
+    fields.append(('label_spec', _spec_struct_to_fields(label_spec)))
+  if global_step is not None:
+    fields.append(('global_step', int(global_step)))
+  return _emit_message(fields) + '\n'
+
+
+# -- pbtxt parsing -----------------------------------------------------------
+
+def _tokenize(text: str):
+  tokens = []
+  i, n = 0, len(text)
+  while i < n:
+    c = text[i]
+    if c in ' \t\r\n':
+      i += 1
+    elif c == '#':
+      while i < n and text[i] != '\n':
+        i += 1
+    elif c in '{}:':
+      tokens.append(c)
+      i += 1
+    elif c == '"':
+      j = i + 1
+      buf = []
+      while j < n and text[j] != '"':
+        if text[j] == '\\':
+          j += 1
+          buf.append(text[j])
+        else:
+          buf.append(text[j])
+        j += 1
+      tokens.append(('STR', ''.join(buf)))
+      i = j + 1
+    else:
+      j = i
+      while j < n and text[j] not in ' \t\r\n{}:#"':
+        j += 1
+      tokens.append(('ATOM', text[i:j]))
+      i = j
+  return tokens
+
+
+def _parse_atom(atom: str):
+  if atom == 'true':
+    return True
+  if atom == 'false':
+    return False
+  try:
+    return int(atom)
+  except ValueError:
+    return float(atom)
+
+
+def _parse_message(tokens, pos: int) -> Tuple[dict, int]:
+  """Parses fields until '}' or EOF. Repeated fields accumulate into lists."""
+  out = collections.OrderedDict()
+
+  def _add(name, value):
+    if name in out:
+      if not isinstance(out[name], list):
+        out[name] = [out[name]]
+      out[name].append(value)
+    else:
+      out[name] = value
+
+  while pos < len(tokens):
+    tok = tokens[pos]
+    if tok == '}':
+      return out, pos + 1
+    if not (isinstance(tok, tuple) and tok[0] == 'ATOM'):
+      raise ValueError('pbtxt parse error near token {}'.format(tok))
+    name = tok[1]
+    pos += 1
+    if tokens[pos] == ':':
+      pos += 1
+      vtok = tokens[pos]
+      pos += 1
+      _add(name, vtok[1] if vtok[0] == 'STR' else _parse_atom(vtok[1]))
+    elif tokens[pos] == '{':
+      sub, pos = _parse_message(tokens, pos + 1)
+      _add(name, sub)
+    else:
+      raise ValueError('pbtxt parse error after field {}'.format(name))
+  return out, pos
+
+
+def parse_pbtxt(text: str) -> dict:
+  try:
+    msg, _ = _parse_message(_tokenize(text), 0)
+  except (IndexError, KeyError) as e:
+    raise ValueError('Malformed pbtxt: {}'.format(e))
+  return msg
+
+
+def _as_list(value):
+  if value is None:
+    return []
+  return value if isinstance(value, list) else [value]
+
+
+def _fields_to_spec_struct(msg) -> SpecStruct:
+  out = SpecStruct()
+  for entry in _as_list(msg.get('key_value')):
+    value = dict(entry['value'])
+    value['shape'] = [int(s) for s in _as_list(value.get('shape'))]
+    out[entry['key']] = TensorSpec.from_dict(value)
+  return out
+
+
+def pbtxt_to_specs(text: str):
+  """Returns (feature_spec, label_spec, global_step)."""
+  msg = parse_pbtxt(text)
+  feature_spec = label_spec = None
+  if 'feature_spec' in msg:
+    feature_spec = _fields_to_spec_struct(msg['feature_spec'])
+  if 'label_spec' in msg:
+    label_spec = _fields_to_spec_struct(msg['label_spec'])
+  return feature_spec, label_spec, msg.get('global_step')
+
+
+# -- file-level API (contract: assets.extra/t2r_assets.pbtxt) ----------------
+
+def write_t2r_assets_to_file(feature_spec, label_spec, global_step,
+                             filename: str) -> None:
+  """ref: tensorspec_utils.py:1680."""
+  if os.path.dirname(filename):
+    os.makedirs(os.path.dirname(filename), exist_ok=True)
+  with open(filename, 'w') as f:
+    f.write(specs_to_pbtxt(feature_spec, label_spec, global_step))
+  json_payload = {
+      'feature_spec': {k: s.to_dict() for k, s in
+                       flatten_spec_structure(feature_spec).items()},
+      'label_spec': {k: s.to_dict() for k, s in
+                     flatten_spec_structure(label_spec).items()},
+      'global_step': int(global_step) if global_step is not None else None,
+  }
+  json_path = os.path.join(os.path.dirname(filename), T2R_ASSETS_JSON_FILENAME)
+  with open(json_path, 'w') as f:
+    json.dump(json_payload, f, indent=2)
+
+
+def load_t2r_assets_from_file(filename: str):
+  """ref: tensorspec_utils.py:1686. Returns (feature_spec, label_spec, step).
+
+  Prefers the lossless JSON twin when present (it preserves is_sequence,
+  which the reference pbtxt schema cannot carry); falls back to the pbtxt.
+  """
+  json_path = os.path.join(os.path.dirname(filename), T2R_ASSETS_JSON_FILENAME)
+  if os.path.exists(json_path):
+    try:
+      with open(json_path) as f:
+        payload = json.load(f)
+      def _load(side):
+        out = SpecStruct()
+        for k, d in (payload.get(side) or {}).items():
+          out[k] = TensorSpec.from_dict(d)
+        return out
+      return _load('feature_spec'), _load('label_spec'), payload.get('global_step')
+    except (ValueError, KeyError):
+      pass  # corrupt twin: fall back to the pbtxt source of truth
+  with open(filename) as f:
+    return pbtxt_to_specs(f.read())
+
+
+def write_input_spec_to_file(feature_spec, label_spec, dirname: str) -> None:
+  """ref: :1698 — writes specs (no step) into dirname/t2r_assets.pbtxt."""
+  write_t2r_assets_to_file(feature_spec, label_spec, None,
+                           os.path.join(dirname, T2R_ASSETS_FILENAME))
+
+
+def load_input_spec_from_file(dirname_or_file: str):
+  """ref: :1705."""
+  path = dirname_or_file
+  if os.path.isdir(path):
+    path = os.path.join(path, T2R_ASSETS_FILENAME)
+  feature_spec, label_spec, _ = load_t2r_assets_from_file(path)
+  return feature_spec, label_spec
+
+
+def write_global_step_to_file(global_step: int, dirname: str) -> None:
+  """ref: :1716 — a bare step file next to exports for cheap reconciliation."""
+  os.makedirs(dirname, exist_ok=True)
+  with open(os.path.join(dirname, GLOBAL_STEP_FILENAME), 'w') as f:
+    f.write(str(int(global_step)))
+
+
+def load_global_step_from_file(dirname: str) -> int:
+  """ref: :1722."""
+  with open(os.path.join(dirname, GLOBAL_STEP_FILENAME)) as f:
+    return int(f.read().strip())

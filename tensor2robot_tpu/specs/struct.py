@@ -1,0 +1,232 @@
+"""SpecStruct: one flat ordered mapping with hierarchical attribute views.
+
+Parity target: the reference's ``TensorSpecStruct``
+(/root/reference/utils/tensorspec_utils.py:306-682). A SpecStruct stores values
+(tensor specs, arrays, jax tracers -- anything) under '/'-separated flat paths
+and exposes:
+
+  * flat dict access:       ``s['train/images']``
+  * attribute access:       ``s.train.images``
+  * hierarchical views:     ``s.train`` is a live view backed by the parent --
+                            mutations through the view are visible everywhere.
+
+Unlike the reference we also register SpecStruct as a JAX pytree, so a struct
+of arrays flows through ``jit`` / ``grad`` / ``vmap`` unchanged, which is what
+lets model code receive the same container at trace time and at numpy time.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator, Mapping, Optional
+
+import jax
+
+_RESERVED = ('_root', '_prefix')
+
+
+def _is_namedtuple(value) -> bool:
+  return isinstance(value, tuple) and hasattr(value, '_fields')
+
+
+class SpecStruct(collections.abc.MutableMapping):
+  """Ordered flat mapping with live hierarchical views."""
+
+  def __init__(self, *others, **kwargs):
+    object.__setattr__(self, '_root', collections.OrderedDict())
+    object.__setattr__(self, '_prefix', '')
+    for other in others:
+      self.update(_as_items(other))
+    for key, value in kwargs.items():
+      self[key] = value
+
+  # -- view plumbing --------------------------------------------------------
+
+  @classmethod
+  def _view(cls, root: collections.OrderedDict, prefix: str) -> 'SpecStruct':
+    view = cls.__new__(cls)
+    object.__setattr__(view, '_root', root)
+    object.__setattr__(view, '_prefix', prefix)
+    return view
+
+  def _abs(self, key: str) -> str:
+    key = key.strip('/')
+    return self._prefix + key if not self._prefix else self._prefix + '/' + key
+
+  # -- MutableMapping interface ---------------------------------------------
+
+  def __getitem__(self, key: str) -> Any:
+    path = self._abs(key)
+    if path in self._root:
+      return self._root[path]
+    # Sub-view if any flat key lives under this path.
+    sub = path + '/'
+    if any(k.startswith(sub) for k in self._root):
+      return SpecStruct._view(self._root, path)
+    raise KeyError(key)
+
+  def __setitem__(self, key: str, value: Any) -> None:
+    path = self._abs(key)
+    if isinstance(value, SpecStruct) or isinstance(value, Mapping) or _is_namedtuple(value):
+      items = list(_as_items(value))
+      if not items:
+        raise ValueError(
+            'Cannot assign an empty mapping to {!r}; delete the key instead.'
+            .format(key))
+      # Setting a subtree: clear existing subtree then splice values in.
+      sub = path + '/'
+      for k in [k for k in self._root if k.startswith(sub)]:
+        del self._root[k]
+      self._root.pop(path, None)
+      for rel, leaf in items:
+        self._root[path + '/' + rel] = leaf
+    else:
+      if any(k.startswith(path + '/') for k in self._root):
+        raise ValueError(
+            'Cannot assign a leaf to {!r}: it is an existing subtree.'.format(key))
+      self._root[path] = value
+
+  def __delitem__(self, key: str) -> None:
+    path = self._abs(key)
+    if path in self._root:
+      del self._root[path]
+      return
+    sub = path + '/'
+    doomed = [k for k in self._root if k.startswith(sub)]
+    if not doomed:
+      raise KeyError(key)
+    for k in doomed:
+      del self._root[k]
+
+  def __iter__(self) -> Iterator[str]:
+    if not self._prefix:
+      yield from list(self._root)
+      return
+    sub = self._prefix + '/'
+    for k in list(self._root):
+      if k.startswith(sub):
+        yield k[len(sub):]
+
+  def __len__(self) -> int:
+    return sum(1 for _ in self.__iter__())
+
+  def __contains__(self, key) -> bool:
+    try:
+      self[key]
+      return True
+    except (KeyError, TypeError):
+      return False
+
+  # -- attribute access ------------------------------------------------------
+
+  def __getattr__(self, name: str) -> Any:
+    if name.startswith('_'):
+      raise AttributeError(name)
+    try:
+      return self[name]
+    except KeyError:
+      raise AttributeError(name)
+
+  def __setattr__(self, name: str, value: Any) -> None:
+    if name in _RESERVED:
+      object.__setattr__(self, name, value)
+    else:
+      self[name] = value
+
+  def __delattr__(self, name: str) -> None:
+    try:
+      del self[name]
+    except KeyError:
+      raise AttributeError(name)
+
+  # -- conveniences ----------------------------------------------------------
+
+  def to_dict(self) -> collections.OrderedDict:
+    """Flat OrderedDict copy of (this view of) the struct."""
+    return collections.OrderedDict((k, self[k]) for k in self)
+
+  def to_nested_dict(self) -> collections.OrderedDict:
+    """Recursive plain-dict copy."""
+    out = collections.OrderedDict()
+    for key in self:
+      head = key.split('/', 1)[0]
+      if head in out:
+        continue
+      value = self[head]
+      out[head] = value.to_nested_dict() if isinstance(value, SpecStruct) else value
+    return out
+
+  def copy(self) -> 'SpecStruct':
+    fresh = SpecStruct()
+    for k in self:
+      fresh[k] = self[k]
+    return fresh
+
+  def __eq__(self, other) -> bool:
+    # Order-insensitive, like the reference's OrderedDict-vs-dict comparison.
+    if not isinstance(other, (SpecStruct, Mapping)):
+      return NotImplemented
+    return dict(self.to_dict()) == dict(_as_flat_dict(other))
+
+  def __ne__(self, other) -> bool:
+    result = self.__eq__(other)
+    return result if result is NotImplemented else not result
+
+  def __repr__(self):
+    return 'SpecStruct({})'.format(
+        ', '.join('{}={!r}'.format(k, v) for k, v in self.to_dict().items()))
+
+
+def _as_items(value):
+  """Yields (flat_key, leaf) pairs from mappings/namedtuples/SpecStructs."""
+  if isinstance(value, SpecStruct):
+    for k in value:
+      yield k, value._root[value._abs(k)]  # pylint: disable=protected-access
+    return
+  if _is_namedtuple(value):
+    value = value._asdict()
+  if isinstance(value, Mapping):
+    for k, v in value.items():
+      if isinstance(v, (SpecStruct, Mapping)) or _is_namedtuple(v):
+        for rel, leaf in _as_items(v):
+          yield str(k) + '/' + rel, leaf
+      else:
+        yield str(k), v
+    return
+  raise ValueError('Cannot build SpecStruct items from {}'.format(type(value)))
+
+
+def _as_flat_dict(value) -> collections.OrderedDict:
+  return collections.OrderedDict(_as_items(value))
+
+
+# -- pytree registration -----------------------------------------------------
+
+def _specstruct_flatten(struct: SpecStruct):
+  items = list(struct.to_dict().items())
+  keys = tuple(k for k, _ in items)
+  values = tuple(v for _, v in items)
+  return values, keys
+
+
+def _specstruct_flatten_with_keys(struct: SpecStruct):
+  items = list(struct.to_dict().items())
+  keys = tuple(k for k, _ in items)
+  keyed = tuple((jax.tree_util.DictKey(k), v) for k, v in items)
+  return keyed, keys
+
+
+def _specstruct_unflatten(keys, values) -> SpecStruct:
+  fresh = SpecStruct()
+  for k, v in zip(keys, values):
+    # Bypass subtree splicing: leaves may themselves be mappings.
+    fresh._root[k] = v  # pylint: disable=protected-access
+  return fresh
+
+
+jax.tree_util.register_pytree_with_keys(
+    SpecStruct, _specstruct_flatten_with_keys, _specstruct_unflatten,
+    _specstruct_flatten)
+
+
+TensorSpecStruct = SpecStruct  # reference-familiar alias

@@ -1,0 +1,292 @@
+"""TensorSpec: a declarative description of a tensor an API accepts or returns.
+
+This is the TPU-native redesign of the reference's ``ExtendedTensorSpec``
+(see /root/reference/utils/tensorspec_utils.py:44-282 for the behavior we
+provide parity with). Instead of subclassing ``tf.TensorSpec`` we use a frozen
+dataclass that is hashable, pytree-friendly, and converts directly to
+``jax.ShapeDtypeStruct`` for trace-time shape validation under ``jax.jit``.
+
+Extended attributes beyond (shape, dtype, name):
+  * ``is_optional``  -- the tensor may be absent from a batch; pipelines drop it.
+  * ``is_sequence``  -- parsed from the sequence side of a SequenceExample
+                        (ragged time dimension, auto ``<name>_length`` tensor).
+  * ``is_extracted`` -- the spec was inferred from a concrete array.
+  * ``data_format``  -- 'jpeg'/'png' etc: the on-disk bytes are an encoded image
+                        that the data pipeline decodes to ``shape``/``dtype``.
+  * ``dataset_key``  -- which of several zipped datasets this tensor comes from.
+  * ``varlen_default_value`` -- treat the on-disk feature as variable length and
+                        pad (with this value) or clip to ``shape[0]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+# bfloat16 is a first-class dtype on TPU; ml_dtypes ships with jax.
+import ml_dtypes
+
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+
+# The on-disk dtype enum used in t2r_assets.pbtxt. Values follow the
+# TensorFlow DataType enum so that assets written by the reference stack can be
+# loaded unchanged (serialization contract, not code, from proto/t2r.proto).
+_DTYPE_TO_ENUM = {
+    np.dtype(np.float16): 19,
+    bfloat16: 14,
+    np.dtype(np.float32): 1,
+    np.dtype(np.float64): 2,
+    np.dtype(np.int8): 6,
+    np.dtype(np.int16): 5,
+    np.dtype(np.int32): 3,
+    np.dtype(np.int64): 9,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.uint16): 17,
+    np.dtype(np.uint32): 22,
+    np.dtype(np.uint64): 23,
+    np.dtype(np.bool_): 10,
+    np.dtype(object): 7,  # string / bytes
+}
+_ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
+
+# Canonical names (numpy names except 'string' and 'bfloat16').
+_DTYPE_TO_NAME = {k: k.name for k in _DTYPE_TO_ENUM}
+_DTYPE_TO_NAME[np.dtype(object)] = 'string'
+_DTYPE_TO_NAME[bfloat16] = 'bfloat16'
+_NAME_TO_DTYPE = {v: k for k, v in _DTYPE_TO_NAME.items()}
+
+ShapeLike = Sequence[Optional[int]]
+DTypeLike = Any
+
+
+def canonical_dtype(dtype: DTypeLike) -> np.dtype:
+  """Normalizes tf/jax/numpy/string dtypes to a numpy dtype (object=string)."""
+  if isinstance(dtype, str):
+    if dtype in _NAME_TO_DTYPE:
+      return _NAME_TO_DTYPE[dtype]
+    return np.dtype(dtype)
+  if isinstance(dtype, int):  # proto enum
+    return _ENUM_TO_DTYPE[dtype]
+  # tf.DType has as_numpy_dtype; jax dtypes convert via np.dtype.
+  as_np = getattr(dtype, 'as_numpy_dtype', None)
+  if as_np is not None:
+    return np.dtype(as_np)
+  if dtype is bytes or dtype is str:
+    return np.dtype(object)
+  return np.dtype(dtype)
+
+
+def dtype_name(dtype: DTypeLike) -> str:
+  return _DTYPE_TO_NAME[canonical_dtype(dtype)]
+
+
+def dtype_enum(dtype: DTypeLike) -> int:
+  return _DTYPE_TO_ENUM[canonical_dtype(dtype)]
+
+
+def _canonical_shape(shape: Union[ShapeLike, int, None]) -> Tuple[Optional[int], ...]:
+  if shape is None:
+    return ()
+  if isinstance(shape, (int, np.integer)):
+    return (int(shape),)
+  out = []
+  for dim in shape:
+    if dim is None or (isinstance(dim, (int, np.integer)) and int(dim) < 0):
+      out.append(None)
+    else:
+      out.append(int(dim))
+  return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorSpec:
+  """Frozen, hashable tensor specification (parity: ExtendedTensorSpec)."""
+
+  shape: Tuple[Optional[int], ...]
+  dtype: np.dtype
+  name: Optional[str] = None
+  is_optional: bool = False
+  is_sequence: bool = False
+  is_extracted: bool = False
+  data_format: Optional[str] = None
+  dataset_key: str = ''
+  varlen_default_value: Optional[float] = None
+
+  def __init__(self,
+               shape: Union[ShapeLike, int, None],
+               dtype: DTypeLike,
+               name: Optional[str] = None,
+               is_optional: Optional[bool] = None,
+               is_sequence: bool = False,
+               is_extracted: bool = False,
+               data_format: Optional[str] = None,
+               dataset_key: Optional[str] = None,
+               varlen_default_value: Optional[float] = None):
+    object.__setattr__(self, 'shape', _canonical_shape(shape))
+    object.__setattr__(self, 'dtype', canonical_dtype(dtype))
+    object.__setattr__(self, 'name', name)
+    object.__setattr__(self, 'is_optional', bool(is_optional) if is_optional is not None else False)
+    object.__setattr__(self, 'is_sequence', bool(is_sequence))
+    object.__setattr__(self, 'is_extracted', bool(is_extracted))
+    object.__setattr__(self, 'data_format', data_format)
+    object.__setattr__(self, 'dataset_key', dataset_key or '')
+    if varlen_default_value is not None:
+      varlen_default_value = float(varlen_default_value)
+      if data_format is None and len(self.shape) != 1:
+        raise ValueError(
+            'varlen specs require rank-1 shapes (got {}) unless they are '
+            'encoded images.'.format(self.shape))
+      if data_format is not None and len(self.shape) != 4:
+        raise ValueError(
+            'varlen image specs require rank-4 shapes (got {}).'.format(
+                self.shape))
+    object.__setattr__(self, 'varlen_default_value', varlen_default_value)
+
+  # -- Constructors ---------------------------------------------------------
+
+  @classmethod
+  def from_spec(cls, spec, **overrides) -> 'TensorSpec':
+    """Copies ``spec`` (TensorSpec or anything with shape/dtype), overriding fields.
+
+    Supports ``batch_size=N`` to prepend a batch dim (or -1/None for unknown),
+    mirroring reference ExtendedTensorSpec.from_spec (tensorspec_utils.py:112).
+    """
+    batch_size = overrides.pop('batch_size', None)
+    kwargs = dict(
+        shape=tuple(getattr(spec, 'shape', ()) or ()),
+        dtype=getattr(spec, 'dtype'),
+        name=getattr(spec, 'name', None),
+        is_optional=getattr(spec, 'is_optional', False),
+        is_sequence=getattr(spec, 'is_sequence', False),
+        is_extracted=getattr(spec, 'is_extracted', False),
+        data_format=getattr(spec, 'data_format', None),
+        dataset_key=getattr(spec, 'dataset_key', ''),
+        varlen_default_value=getattr(spec, 'varlen_default_value', None),
+    )
+    for key, value in overrides.items():
+      if value is not None or key in ('name', 'data_format'):
+        kwargs[key] = value
+    if batch_size is not None:
+      batch = None if int(batch_size) < 0 else int(batch_size)
+      kwargs['shape'] = (batch,) + tuple(kwargs['shape'])
+    return cls(**kwargs)
+
+  @classmethod
+  def from_tensor(cls, tensor, name: Optional[str] = None) -> 'TensorSpec':
+    """Infers a spec from a concrete array (marks is_extracted=True)."""
+    arr = np.asarray(tensor) if not hasattr(tensor, 'shape') else tensor
+    return cls(shape=tuple(arr.shape), dtype=arr.dtype, name=name,
+               is_extracted=True)
+
+  @classmethod
+  def to_spec(cls, instance_or_spec, name: Optional[str] = None) -> 'TensorSpec':
+    if isinstance(instance_or_spec, TensorSpec):
+      return instance_or_spec
+    if hasattr(instance_or_spec, 'shape') and hasattr(instance_or_spec, 'dtype'):
+      # Covers np arrays, jax arrays, ShapeDtypeStruct, tf.TensorSpec.
+      if type(instance_or_spec).__name__ in ('TensorSpec', 'BoundedTensorSpec'):
+        return cls.from_spec(instance_or_spec, name=name)
+      return cls.from_tensor(instance_or_spec, name=name)
+    raise ValueError(
+        'Cannot convert {} to TensorSpec.'.format(type(instance_or_spec)))
+
+  # -- Serialization (t2r_assets contract) ----------------------------------
+
+  def to_dict(self) -> dict:
+    d = {
+        'shape': [(-1 if s is None else int(s)) for s in self.shape],
+        'dtype': dtype_enum(self.dtype),
+    }
+    if self.name is not None:
+      d['name'] = self.name
+    if self.is_optional:
+      d['is_optional'] = True
+    if self.is_extracted:
+      d['is_extracted'] = True
+    if self.is_sequence:
+      d['is_sequence'] = True
+    if self.data_format is not None:
+      d['data_format'] = self.data_format
+    if self.dataset_key:
+      d['dataset_key'] = self.dataset_key
+    if self.varlen_default_value is not None:
+      d['varlen_default_value'] = float(self.varlen_default_value)
+    return d
+
+  @classmethod
+  def from_dict(cls, d: dict) -> 'TensorSpec':
+    return cls(
+        shape=[(None if s < 0 else s) for s in d.get('shape', [])],
+        dtype=d.get('dtype', 1),
+        name=d.get('name'),
+        is_optional=d.get('is_optional', False),
+        is_sequence=d.get('is_sequence', False),
+        is_extracted=d.get('is_extracted', False),
+        data_format=d.get('data_format'),
+        dataset_key=d.get('dataset_key'),
+        varlen_default_value=d.get('varlen_default_value'),
+    )
+
+  # -- JAX interop ----------------------------------------------------------
+
+  @property
+  def jax_dtype(self):
+    if self.dtype == np.dtype(object):
+      raise ValueError('string spec {} has no jax dtype'.format(self.name))
+    return jax.numpy.dtype(self.dtype)
+
+  def shape_dtype_struct(self, batch_size: Optional[int] = None):
+    """Returns jax.ShapeDtypeStruct, optionally prepending a batch dim."""
+    shape = tuple(1 if s is None else s for s in self.shape)
+    if batch_size is not None:
+      shape = (batch_size,) + shape
+    return jax.ShapeDtypeStruct(shape, self.jax_dtype)
+
+  # -- Introspection --------------------------------------------------------
+
+  @property
+  def is_encoded_image(self) -> bool:
+    return self.data_format is not None and self.data_format.lower() in (
+        'jpeg', 'jpg', 'png', 'webp', 'bmp')
+
+  def is_compatible_with(self, other) -> bool:
+    """Shape/dtype compatibility. None dims match any size."""
+    other_shape = tuple(getattr(other, 'shape', ()))
+    other_dtype = canonical_dtype(getattr(other, 'dtype'))
+    if other_dtype != self.dtype:
+      return False
+    if len(other_shape) != len(self.shape):
+      return False
+    for mine, theirs in zip(self.shape, other_shape):
+      if mine is None or theirs is None:
+        continue
+      if int(mine) != int(theirs):
+        return False
+    return True
+
+  def __repr__(self):
+    extras = []
+    for field in ('is_optional', 'is_sequence', 'is_extracted'):
+      if getattr(self, field):
+        extras.append('{}=True'.format(field))
+    if self.data_format:
+      extras.append('data_format={}'.format(self.data_format))
+    if self.dataset_key:
+      extras.append('dataset_key={}'.format(self.dataset_key))
+    if self.varlen_default_value is not None:
+      extras.append('varlen_default_value={}'.format(self.varlen_default_value))
+    return 'TensorSpec(shape={}, dtype={}, name={}{})'.format(
+        self.shape, dtype_name(self.dtype), self.name,
+        (', ' + ', '.join(extras)) if extras else '')
+
+  def __hash__(self):
+    return hash((self.shape, self.dtype, self.name, self.is_optional,
+                 self.is_sequence, self.data_format, self.dataset_key,
+                 self.varlen_default_value))
+
+
+# Alias matching the reference public name so user code reads familiarly.
+ExtendedTensorSpec = TensorSpec

@@ -1,0 +1,16 @@
+"""Test environment: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip sharding is validated without TPU hardware by forcing the host
+platform to expose 8 devices (SURVEY.md §4: the JAX analog of the reference's
+TPU-without-TPU estimator tests).
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+xla_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in xla_flags:
+  os.environ['XLA_FLAGS'] = (
+      xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+# Keep compilation deterministic and quiet in tests.
+os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')

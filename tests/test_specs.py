@@ -1,0 +1,269 @@
+"""Spec-core tests: TensorSpec, SpecStruct, algebra, generators, assets.
+
+Mirrors the coverage themes of the reference's tensorspec_utils_test.py.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import TensorSpec, SpecStruct
+
+
+def _simple_specs():
+  s = SpecStruct()
+  s['images'] = TensorSpec((64, 64, 3), np.uint8, name='images',
+                           data_format='jpeg')
+  s['state'] = TensorSpec((8,), np.float32, name='state')
+  s['aux/debug'] = TensorSpec((2,), np.float32, name='debug', is_optional=True)
+  return s
+
+
+class TestTensorSpec:
+
+  def test_basic_fields(self):
+    spec = TensorSpec((4, None), 'float32', name='x', is_optional=True,
+                      dataset_key='d1')
+    assert spec.shape == (4, None)
+    assert spec.dtype == np.float32
+    assert spec.is_optional and spec.dataset_key == 'd1'
+
+  def test_from_spec_overrides_and_batch(self):
+    base = TensorSpec((8,), np.float32, name='state')
+    derived = TensorSpec.from_spec(base, batch_size=32, name='s2')
+    assert derived.shape == (32, 8)
+    assert derived.name == 's2'
+    unknown_batch = TensorSpec.from_spec(base, batch_size=-1)
+    assert unknown_batch.shape == (None, 8)
+
+  def test_from_tensor(self):
+    spec = TensorSpec.from_tensor(np.zeros((3, 2), np.int32), name='z')
+    assert spec.shape == (3, 2) and spec.dtype == np.int32 and spec.is_extracted
+
+  def test_varlen_validation(self):
+    TensorSpec((10,), np.float32, varlen_default_value=0.0)
+    with pytest.raises(ValueError):
+      TensorSpec((10, 2), np.float32, varlen_default_value=0.0)
+    TensorSpec((10, 32, 32, 3), np.uint8, data_format='jpeg',
+               varlen_default_value=0.0)
+    with pytest.raises(ValueError):
+      TensorSpec((10, 3), np.uint8, data_format='jpeg',
+                 varlen_default_value=0.0)
+
+  def test_dict_round_trip(self):
+    spec = TensorSpec((4, 3), specs.bfloat16, name='b', is_sequence=True,
+                      dataset_key='k', data_format='png')
+    again = TensorSpec.from_dict(spec.to_dict())
+    assert again == spec
+
+  def test_shape_dtype_struct(self):
+    spec = TensorSpec((8,), np.float32, name='s')
+    sds = spec.shape_dtype_struct(batch_size=4)
+    assert sds.shape == (4, 8) and sds.dtype == jnp.float32
+
+  def test_compatibility(self):
+    spec = TensorSpec((None, 8), np.float32)
+    assert spec.is_compatible_with(np.zeros((5, 8), np.float32))
+    assert not spec.is_compatible_with(np.zeros((5, 7), np.float32))
+    assert not spec.is_compatible_with(np.zeros((5, 8), np.int32))
+
+
+class TestSpecStruct:
+
+  def test_flat_and_attribute_views(self):
+    s = SpecStruct()
+    s['train/state'] = 1
+    s['train/action'] = 2
+    s['val/state'] = 3
+    assert s.train.state == 1
+    assert s['train/action'] == 2
+    assert list(s.train) == ['state', 'action']
+    # Views are live: mutate through the view, see it in the root.
+    view = s.train
+    view.state = 10
+    assert s['train/state'] == 10
+    view['new'] = 5
+    assert s['train/new'] == 5
+
+  def test_nested_construction(self):
+    s = SpecStruct({'a': {'b': 1, 'c': 2}, 'd': 3})
+    assert s['a/b'] == 1 and s.d == 3
+    assert s.to_nested_dict() == {'a': {'b': 1, 'c': 2}, 'd': 3}
+
+  def test_subtree_assignment_and_delete(self):
+    s = SpecStruct()
+    s.cond = {'x': 1, 'y': 2}
+    assert s['cond/x'] == 1
+    del s['cond']
+    assert len(s) == 0
+
+  def test_pytree(self):
+    s = SpecStruct()
+    s['a/b'] = jnp.ones((2,))
+    s['c'] = jnp.zeros((3,))
+    doubled = jax.tree.map(lambda x: x * 2, s)
+    assert isinstance(doubled, SpecStruct)
+    assert float(doubled['a/b'][0]) == 2.0
+    leaves = jax.tree.leaves(s)
+    assert len(leaves) == 2
+
+  def test_jit_through(self):
+    s = SpecStruct()
+    s['x'] = jnp.arange(4.0)
+
+    @jax.jit
+    def f(struct):
+      out = SpecStruct()
+      out['y'] = struct['x'] * 2
+      return out
+
+    out = f(s)
+    assert float(out.y[1]) == 2.0
+
+
+class TestAlgebra:
+
+  def test_flatten_and_validate_pack(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=4)
+    packed = specs.validate_and_pack(spec, batch, ignore_batch=True)
+    assert packed['images'].shape == (4, 64, 64, 3)
+    assert packed.aux.debug.shape == (4, 2)
+
+  def test_optional_dropped(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    del batch['aux/debug']
+    packed = specs.validate_and_pack(spec, batch, ignore_batch=True)
+    assert 'aux/debug' not in packed
+
+  def test_required_missing_raises(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    del batch['state']
+    with pytest.raises(ValueError, match='Required'):
+      specs.validate_and_flatten(spec, batch, ignore_batch=True)
+
+  def test_shape_mismatch_raises(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    batch['state'] = np.zeros((2, 7), np.float32)
+    with pytest.raises(ValueError, match='shape|rank'):
+      specs.validate_and_flatten(spec, batch, ignore_batch=True)
+
+  def test_dtype_mismatch_raises(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    batch['state'] = batch['state'].astype(np.float64)
+    with pytest.raises(ValueError, match='dtype'):
+      specs.validate_and_flatten(spec, batch, ignore_batch=True)
+
+  def test_name_uniqueness_enforced(self):
+    s = SpecStruct()
+    s['a'] = TensorSpec((2,), np.float32, name='same')
+    s['b'] = TensorSpec((3,), np.float32, name='same')
+    with pytest.raises(ValueError, match='Duplicate'):
+      specs.assert_valid_spec_structure(s)
+
+  def test_copy_tensorspec_batch_and_prefix(self):
+    spec = _simple_specs()
+    copied = specs.copy_tensorspec(spec, batch_size=16, prefix='p')
+    assert copied['state'].shape == (16, 8)
+    assert copied['state'].name == 'p/state'
+
+  def test_replace_dtype_and_cast(self):
+    spec = _simple_specs()
+    bf16 = specs.replace_dtype(spec, np.float32, specs.bfloat16)
+    assert bf16['state'].dtype == specs.bfloat16
+    assert bf16['images'].dtype == np.uint8
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    cast = specs.cast_to_dtype(batch, np.float32, specs.bfloat16)
+    assert cast['state'].dtype == specs.bfloat16
+
+  def test_filter_required(self):
+    required = specs.filter_required_flat_tensor_spec(_simple_specs())
+    assert 'aux/debug' not in required and 'state' in required
+
+  def test_filter_by_dataset(self):
+    s = SpecStruct()
+    s['a'] = TensorSpec((2,), np.float32, dataset_key='d1')
+    s['b'] = TensorSpec((2,), np.float32, dataset_key='d2')
+    assert list(specs.filter_spec_structure_by_dataset(s, 'd1')) == ['a']
+    assert specs.dataset_keys(s) == ['d1', 'd2']
+
+  def test_sequence_length_specs(self):
+    s = SpecStruct()
+    s['frames'] = TensorSpec((32, 32, 3), np.uint8, name='frames',
+                             is_sequence=True)
+    out = specs.add_sequence_length_specs(s)
+    assert 'frames_length' in out
+    assert out['frames_length'].dtype == np.int64
+
+  def test_pad_or_clip(self):
+    spec = TensorSpec((5,), np.float32, varlen_default_value=-1.0)
+    padded = specs.pad_or_clip_tensor_to_spec_shape(
+        np.ones((3,), np.float32), spec)
+    assert padded.shape == (5,) and padded[-1] == -1.0
+    clipped = specs.pad_or_clip_tensor_to_spec_shape(
+        np.ones((9,), np.float32), spec)
+    assert clipped.shape == (5,)
+
+
+class TestGenerators:
+
+  def test_random_and_constant(self):
+    spec = _simple_specs()
+    rnd = specs.make_random_numpy(spec, batch_size=3, seed=0)
+    assert rnd['images'].dtype == np.uint8
+    const = specs.make_constant_numpy(spec, 2.0, batch_size=3)
+    assert float(const['state'][0, 0]) == 2.0
+
+  def test_sequence_dim(self):
+    s = SpecStruct()
+    s['frames'] = TensorSpec((4, 4, 3), np.uint8, is_sequence=True)
+    batch = specs.make_random_numpy(s, batch_size=2, sequence_length=7)
+    assert batch['frames'].shape == (2, 7, 4, 4, 3)
+
+  def test_placeholders(self):
+    ph = specs.make_placeholders(_simple_specs(), batch_size=2)
+    assert ph['state'].shape == (2, 8)
+
+  def test_feed_dict(self):
+    spec = _simple_specs()
+    batch = specs.make_random_numpy(spec, batch_size=2)
+    feed = specs.map_feed_dict(spec, batch, ignore_batch=True)
+    assert set(feed) == {'images', 'state', 'debug'}
+
+
+class TestAssets:
+
+  def test_pbtxt_round_trip(self, tmp_path):
+    feature_spec = _simple_specs()
+    label_spec = SpecStruct()
+    label_spec['target'] = TensorSpec((2,), np.float32, name='target',
+                                      varlen_default_value=0.5)
+    path = os.path.join(str(tmp_path), specs.EXTRA_ASSETS_DIRECTORY,
+                        specs.T2R_ASSETS_FILENAME)
+    specs.write_t2r_assets_to_file(feature_spec, label_spec, 1234, path)
+    f2, l2, step = specs.load_t2r_assets_from_file(path)
+    assert step == 1234
+    assert set(f2.keys()) == set(feature_spec.keys())
+    for k in feature_spec:
+      assert f2[k] == feature_spec[k], k
+    assert l2['target'].varlen_default_value == 0.5
+
+  def test_input_spec_round_trip(self, tmp_path):
+    d = str(tmp_path)
+    specs.write_input_spec_to_file(_simple_specs(), SpecStruct(
+        y=TensorSpec((1,), np.float32, name='y')), d)
+    f2, l2 = specs.load_input_spec_from_file(d)
+    assert 'images' in f2 and 'y' in l2
+
+  def test_global_step_file(self, tmp_path):
+    d = str(tmp_path)
+    specs.write_global_step_to_file(77, d)
+    assert specs.load_global_step_from_file(d) == 77
