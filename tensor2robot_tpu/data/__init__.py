@@ -1,0 +1,35 @@
+"""Data pipeline: TFRecord I/O, spec-driven Example parsing, input generators."""
+
+from tensor2robot_tpu.data.tfrecord import (
+    TFRecordWriter,
+    read_all_records,
+    tfrecord_iterator,
+    write_records,
+)
+from tensor2robot_tpu.data.wire import (
+    build_example,
+    build_sequence_example,
+    parse_example,
+    parse_sequence_example,
+)
+from tensor2robot_tpu.data.parser import (
+    ExampleParser,
+    build_example_for_specs,
+    decode_image,
+)
+from tensor2robot_tpu.data.pipeline import (
+    BatchedExampleStream,
+    RecordDataset,
+    parse_file_patterns,
+)
+from tensor2robot_tpu.data.input_generators import (
+    AbstractInputGenerator,
+    DefaultConstantInputGenerator,
+    DefaultRandomInputGenerator,
+    DefaultRecordInputGenerator,
+    FractionalRecordInputGenerator,
+    GeneratorInputGenerator,
+    MultiEvalRecordInputGenerator,
+    get_multi_eval_name,
+)
+from tensor2robot_tpu.data.writer import TFRecordReplayWriter

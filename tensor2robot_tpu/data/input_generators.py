@@ -1,0 +1,252 @@
+"""Input generators: bind model specs to data sources, yield numpy batches.
+
+Parity targets:
+  * AbstractInputGenerator     ref input_generators/abstract_input_generator.py:38
+  * DefaultRecordInputGenerator / FractionalRecordInputGenerator /
+    MultiEvalRecordInputGenerator  ref input_generators/default_input_generator.py:54,118,141
+  * GeneratorInputGenerator / DefaultRandomInputGenerator /
+    DefaultConstantInputGenerator  ref default_input_generator.py:156,210,223
+
+Redesign note: the reference returns Estimator ``input_fn``s; here a generator
+yields ``(features, labels)`` numpy batches sized for the *global* batch. The
+trainer shards each batch over the mesh data axis and runs the preprocessor
+inside the jitted train step (device-side, XLA-fused) — so generators stay
+pure host-side decode.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+from typing import Callable, Dict, Iterator, Optional, Sequence, Union
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data.parser import ExampleParser
+from tensor2robot_tpu.data.pipeline import BatchedExampleStream, RecordDataset
+from tensor2robot_tpu.modes import ModeKeys, assert_valid_mode
+
+
+class AbstractInputGenerator(abc.ABC):
+  """Binds a model's (preprocessor's) in-specs to a batch source."""
+
+  def __init__(self, batch_size: int = 32):
+    self._batch_size = int(batch_size)
+    self._feature_spec = None
+    self._label_spec = None
+    self._preprocess_fn = None
+
+  @property
+  def batch_size(self) -> int:
+    return self._batch_size
+
+  @batch_size.setter
+  def batch_size(self, value: int) -> None:
+    self._batch_size = int(value)
+
+  def set_specification_from_model(self, model, mode: str) -> None:
+    """Pulls the in-feature/in-label specs from the model's preprocessor.
+
+    ref: abstract_input_generator.py:80 — the input pipeline produces what the
+    preprocessor consumes, not what the model consumes.
+    """
+    assert_valid_mode(mode)
+    preprocessor = model.preprocessor
+    self._feature_spec = preprocessor.get_in_feature_specification(mode)
+    self._label_spec = preprocessor.get_in_label_specification(mode)
+    specs_lib.assert_valid_spec_structure(self._feature_spec)
+    specs_lib.assert_valid_spec_structure(self._label_spec)
+
+  def set_specification(self, feature_spec, label_spec) -> None:
+    self._feature_spec = specs_lib.flatten_spec_structure(feature_spec)
+    self._label_spec = specs_lib.flatten_spec_structure(label_spec)
+
+  @property
+  def feature_spec(self):
+    return self._feature_spec
+
+  @property
+  def label_spec(self):
+    return self._label_spec
+
+  def create_dataset_iterator(
+      self, mode: str,
+      num_epochs: Optional[int] = None,
+      shard_index: int = 0, num_shards: int = 1,
+      seed: Optional[int] = None) -> Iterator:
+    """Yields (features, labels) numpy batch SpecStructs."""
+    assert_valid_mode(mode)
+    if self._feature_spec is None:
+      raise ValueError(
+          'set_specification(_from_model) must be called before creating '
+          'a dataset iterator.')
+    return self._create_iterator(mode=mode, num_epochs=num_epochs,
+                                 shard_index=shard_index,
+                                 num_shards=num_shards, seed=seed)
+
+  @abc.abstractmethod
+  def _create_iterator(self, mode: str, num_epochs, shard_index, num_shards,
+                       seed) -> Iterator:
+    ...
+
+
+class DefaultRecordInputGenerator(AbstractInputGenerator):
+  """TFRecord-backed input generator, optionally joining multiple datasets.
+
+  ``file_patterns``: 'path/a*' or 'tfrecord:path/a*,path/b*'.
+  ``dataset_map``: {dataset_key: file_patterns} for multi-dataset zip driven
+  by the specs' ``dataset_key`` attributes.
+  """
+
+  def __init__(self, file_patterns: Optional[str] = None,
+               dataset_map: Optional[Dict[str, str]] = None,
+               batch_size: int = 32,
+               shuffle_buffer_size: int = 500,
+               prefetch: int = 2):
+    super().__init__(batch_size=batch_size)
+    if not file_patterns and not dataset_map:
+      raise ValueError('file_patterns or dataset_map is required.')
+    if file_patterns and dataset_map:
+      raise ValueError('file_patterns and dataset_map are mutually exclusive.')
+    self._file_patterns = file_patterns
+    self._dataset_map = dataset_map
+    self._shuffle_buffer_size = shuffle_buffer_size
+    self._prefetch = prefetch
+
+  def _dataset_files(self) -> Dict[str, str]:
+    if self._dataset_map is not None:
+      return dict(self._dataset_map)
+    return {'': self._file_patterns}
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    parser = ExampleParser(self._feature_spec, self._label_spec)
+    datasets = {
+        key: RecordDataset(patterns, dataset_key=key,
+                           shard_index=shard_index, num_shards=num_shards)
+        for key, patterns in self._dataset_files().items()
+    }
+    missing = set(parser.dataset_keys) - set(datasets)
+    if missing:
+      raise ValueError(
+          'Specs reference dataset keys {} with no configured files; have {}.'
+          .format(sorted(missing), sorted(datasets)))
+    stream = BatchedExampleStream(
+        datasets, parser, batch_size=self._batch_size,
+        shuffle=(mode == ModeKeys.TRAIN),
+        shuffle_buffer=self._shuffle_buffer_size,
+        num_epochs=num_epochs, seed=seed, prefetch=self._prefetch)
+    return iter(stream)
+
+
+class FractionalRecordInputGenerator(DefaultRecordInputGenerator):
+  """Uses only a fraction of the matched files (data ablations, ref :118)."""
+
+  def __init__(self, file_fraction: float = 1.0, **kwargs):
+    super().__init__(**kwargs)
+    if not 0.0 < file_fraction <= 1.0:
+      raise ValueError('file_fraction must be in (0, 1].')
+    self._file_fraction = file_fraction
+
+  def _dataset_files(self) -> Dict[str, str]:
+    out = {}
+    for key, patterns in super()._dataset_files().items():
+      if self._file_fraction < 1.0:
+        from tensor2robot_tpu.data.pipeline import parse_file_patterns
+        _, files = parse_file_patterns(patterns)
+        n = max(1, int(self._file_fraction * len(files)))
+        patterns = ','.join(files[:n])
+      out[key] = patterns
+    return out
+
+
+def get_multi_eval_name(default: Optional[str] = None) -> Optional[str]:
+  """Reads the eval-dataset selector from TF_CONFIG (ref :42-50)."""
+  tf_config = os.environ.get('TF_CONFIG')
+  if not tf_config:
+    return default
+  try:
+    return json.loads(tf_config).get('multi_eval_name', default)
+  except (ValueError, AttributeError):
+    return default
+
+
+class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
+  """Picks the eval dataset named by TF_CONFIG.multi_eval_name (ref :141)."""
+
+  def __init__(self, eval_map: Dict[str, str], **kwargs):
+    multi_eval_name = get_multi_eval_name()
+    if multi_eval_name is None:
+      raise ValueError('TF_CONFIG.multi_eval_name must be set for '
+                       'MultiEvalRecordInputGenerator.')
+    if multi_eval_name not in eval_map:
+      raise ValueError('multi_eval_name {!r} not in eval_map {}.'.format(
+          multi_eval_name, sorted(eval_map)))
+    self.multi_eval_name = multi_eval_name
+    super().__init__(file_patterns=eval_map[multi_eval_name], **kwargs)
+
+
+class GeneratorInputGenerator(AbstractInputGenerator):
+  """Wraps a python generator of spec-conforming numpy batches (ref :156)."""
+
+  def __init__(self, batch_generator_fn: Optional[Callable] = None,
+               batch_size: int = 32, sequence_length: Optional[int] = None):
+    super().__init__(batch_size=batch_size)
+    self._batch_generator_fn = batch_generator_fn
+    self._sequence_length = sequence_length
+
+  def _generate_batch(self, seed: Optional[int]):
+    if self._batch_generator_fn is None:
+      raise NotImplementedError(
+          'Provide batch_generator_fn or override _generate_batch.')
+    return self._batch_generator_fn(self._batch_size)
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    def _iter():
+      step = 0
+      while num_epochs is None or step < num_epochs:
+        batch = self._generate_batch(None if seed is None else seed + step)
+        if isinstance(batch, tuple):
+          features, labels = batch
+        else:
+          features, labels = batch, None
+        features = specs_lib.validate_and_pack(
+            self._feature_spec, features, ignore_batch=True)
+        if labels is not None and len(self._label_spec):
+          labels = specs_lib.validate_and_pack(
+              self._label_spec, labels, ignore_batch=True)
+        yield features, labels
+        step += 1
+    return _iter()
+
+
+class DefaultRandomInputGenerator(GeneratorInputGenerator):
+  """Spec-conforming random batches — the test-data backbone (ref :210)."""
+
+  def _generate_batch(self, seed: Optional[int]):
+    features = specs_lib.make_random_numpy(
+        self._feature_spec, batch_size=self._batch_size,
+        sequence_length=self._sequence_length or 3, seed=seed)
+    labels = specs_lib.make_random_numpy(
+        self._label_spec, batch_size=self._batch_size,
+        sequence_length=self._sequence_length or 3,
+        seed=None if seed is None else seed + 977)
+    return features, labels
+
+
+class DefaultConstantInputGenerator(GeneratorInputGenerator):
+  """Spec-conforming constant batches (ref :223)."""
+
+  def __init__(self, constant_value: float, **kwargs):
+    super().__init__(**kwargs)
+    self._constant_value = constant_value
+
+  def _generate_batch(self, seed: Optional[int]):
+    features = specs_lib.make_constant_numpy(
+        self._feature_spec, self._constant_value, batch_size=self._batch_size,
+        sequence_length=self._sequence_length or 3)
+    labels = specs_lib.make_constant_numpy(
+        self._label_spec, self._constant_value, batch_size=self._batch_size,
+        sequence_length=self._sequence_length or 3)
+    return features, labels

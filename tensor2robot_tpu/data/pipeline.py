@@ -1,0 +1,227 @@
+"""Host-side record pipeline: glob → interleave → shuffle → batch → prefetch.
+
+Parity target: /root/reference/utils/tfdata.py:97-219,527-606
+(default_input_fn_tmpl). A deliberately simple, dependency-free pipeline:
+records stream from TFRecord shards with round-robin interleave, a bounded
+shuffle buffer, per-dataset zip, spec-driven parse, and a background-thread
+prefetch queue that overlaps host decode with device steps. Multi-host
+sharding slices the file list per process (the JAX analog of the reference's
+per-host input_fn invocation, utils/tfdata.py:43-66).
+"""
+
+from __future__ import annotations
+
+import glob as glob_lib
+import itertools
+import queue
+import random
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from tensor2robot_tpu.data import tfrecord
+from tensor2robot_tpu.data.parser import ExampleParser
+
+_SUPPORTED_FORMATS = ('tfrecord',)
+
+
+def parse_file_patterns(file_patterns: Union[str, Sequence[str]]):
+  """Resolves 'tfrecord:/path/a*,-/path/b*' style patterns to (format, files).
+
+  ref: utils/tfdata.py:97-119 — patterns may carry a '<format>:' prefix and
+  be comma-separated.
+  """
+  if isinstance(file_patterns, str):
+    patterns = [p for p in file_patterns.split(',') if p]
+  else:
+    patterns = list(file_patterns)
+  data_format = 'tfrecord'
+  filenames: List[str] = []
+  for pattern in patterns:
+    if ':' in pattern and pattern.split(':', 1)[0] in _SUPPORTED_FORMATS:
+      data_format, pattern = pattern.split(':', 1)
+    matched = sorted(glob_lib.glob(pattern))
+    if not matched and glob_lib.has_magic(pattern):
+      raise ValueError('No files match pattern {!r}.'.format(pattern))
+    filenames.extend(matched if matched else [pattern])
+  if not filenames:
+    raise ValueError('Empty file pattern {!r}.'.format(file_patterns))
+  return data_format, filenames
+
+
+def _interleaved_records(filenames: List[str], cycle_length: int = 4,
+                         shuffle_files: bool = False,
+                         seed: Optional[int] = None) -> Iterator[bytes]:
+  """Round-robin interleave of records across shards (ref :548-558)."""
+  files = list(filenames)
+  if shuffle_files:
+    random.Random(seed).shuffle(files)
+  active = []
+  pending = iter(files)
+  for _ in range(cycle_length):
+    path = next(pending, None)
+    if path is not None:
+      active.append(tfrecord.tfrecord_iterator(path))
+  while active:
+    done = []
+    for it in active:
+      record = next(it, None)
+      if record is None:
+        done.append(it)
+      else:
+        yield record
+    for it in done:
+      active.remove(it)
+      path = next(pending, None)
+      if path is not None:
+        active.append(tfrecord.tfrecord_iterator(path))
+
+
+def _shuffled(records: Iterator[bytes], buffer_size: int,
+              seed: Optional[int]) -> Iterator[bytes]:
+  """Bounded reservoir shuffle (ref shuffle(500), :560)."""
+  rng = random.Random(seed)
+  buf: List[bytes] = []
+  for record in records:
+    buf.append(record)
+    if len(buf) >= buffer_size:
+      idx = rng.randrange(len(buf))
+      buf[idx], buf[-1] = buf[-1], buf[idx]
+      yield buf.pop()
+  rng.shuffle(buf)
+  yield from buf
+
+
+class RecordDataset:
+  """One logical dataset: a set of TFRecord shards."""
+
+  def __init__(self, file_patterns: Union[str, Sequence[str]],
+               dataset_key: str = '',
+               shard_index: int = 0, num_shards: int = 1):
+    self.data_format, filenames = parse_file_patterns(file_patterns)
+    # Multi-host: each process reads its slice of the shard list.
+    self.filenames = filenames[shard_index::num_shards]
+    if not self.filenames:
+      raise ValueError(
+          'Host {} of {} has no files: only {} shard file(s) matched. '
+          'Provide at least num_shards files for multi-host reads.'.format(
+              shard_index, num_shards, len(filenames)))
+    self.dataset_key = dataset_key
+
+  def iter_records(self, shuffle: bool = False, shuffle_buffer: int = 500,
+                   num_epochs: Optional[int] = None,
+                   seed: Optional[int] = None) -> Iterator[bytes]:
+    epoch = 0
+    while num_epochs is None or epoch < num_epochs:
+      records = _interleaved_records(
+          self.filenames, shuffle_files=shuffle,
+          seed=None if seed is None else seed + epoch)
+      if shuffle:
+        records = _shuffled(records, shuffle_buffer,
+                            None if seed is None else seed + epoch)
+      yield from records
+      epoch += 1
+
+
+class BatchedExampleStream:
+  """Zips datasets, parses with specs, batches, and prefetches on a thread."""
+
+  def __init__(self,
+               datasets: Union[RecordDataset, Dict[str, RecordDataset]],
+               parser: ExampleParser,
+               batch_size: int,
+               shuffle: bool = False,
+               shuffle_buffer: int = 500,
+               num_epochs: Optional[int] = None,
+               seed: Optional[int] = None,
+               drop_remainder: bool = True,
+               prefetch: int = 2):
+    if isinstance(datasets, RecordDataset):
+      datasets = {datasets.dataset_key: datasets}
+    self._datasets = datasets
+    self._parser = parser
+    self._batch_size = int(batch_size)
+    self._shuffle = shuffle
+    self._shuffle_buffer = shuffle_buffer
+    self._num_epochs = num_epochs
+    self._seed = seed
+    self._drop_remainder = drop_remainder
+    self._prefetch = prefetch
+
+  def _record_tuples(self) -> Iterator[Dict[str, bytes]]:
+    iters = {
+        key: ds.iter_records(self._shuffle, self._shuffle_buffer,
+                             self._num_epochs, self._seed)
+        for key, ds in self._datasets.items()
+    }
+    while True:
+      tup = {}
+      for key, it in iters.items():
+        record = next(it, None)
+        if record is None:
+          return  # zip ends with the shortest dataset
+        tup[key] = record
+      yield tup
+
+  def _batches(self):
+    pending: List[Dict[str, bytes]] = []
+    for tup in self._record_tuples():
+      pending.append(tup)
+      if len(pending) == self._batch_size:
+        yield self._parse(pending)
+        pending = []
+    if pending and not self._drop_remainder:
+      yield self._parse(pending)
+
+  def _parse(self, tuples: List[Dict[str, bytes]]):
+    by_key = {key: [t[key] for t in tuples] for key in tuples[0]}
+    if list(by_key.keys()) == ['']:
+      return self._parser.parse_batch(by_key[''])
+    return self._parser.parse_batch(by_key)
+
+  def __iter__(self):
+    """Yields (features, labels) batches, decoded ahead on a worker thread."""
+    if self._prefetch <= 0:
+      yield from self._batches()
+      return
+    q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+    sentinel = object()
+    error: List[BaseException] = []
+    stop = threading.Event()
+
+    def _worker():
+      try:
+        for batch in self._batches():
+          # Bounded put so an abandoned consumer lets the worker exit
+          # instead of pinning the thread and open file handles forever.
+          while not stop.is_set():
+            try:
+              q.put(batch, timeout=0.1)
+              break
+            except queue.Full:
+              continue
+          if stop.is_set():
+            return
+      except BaseException as e:  # surfaced on the consumer side
+        error.append(e)
+      finally:
+        while not stop.is_set():
+          try:
+            q.put(sentinel, timeout=0.1)
+            break
+          except queue.Full:
+            continue
+
+    thread = threading.Thread(target=_worker, daemon=True)
+    thread.start()
+    try:
+      while True:
+        item = q.get()
+        if item is sentinel:
+          if error:
+            raise error[0]
+          return
+        yield item
+    finally:
+      stop.set()

@@ -1,0 +1,112 @@
+"""TFRecord container I/O without TensorFlow.
+
+The TFRecord framing is public and tiny: each record is
+``[uint64 length][uint32 masked_crc32c(length)][bytes data][uint32
+masked_crc32c(data)]`` (little-endian). Keeping the reader dependency-free
+lets data workers avoid importing the TF runtime; a C++ fast path can slot in
+underneath later without changing callers.
+
+Parity: the reference reads TFRecords via tf.data (utils/tfdata.py:155-219)
+and writes them with tf.python_io.TFRecordWriter (utils/writer.py:31).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional
+
+try:
+  import google_crc32c
+
+  def _crc32c(data: bytes) -> int:
+    return google_crc32c.value(data)
+except ImportError:  # pragma: no cover - google_crc32c ships in this image
+  import zlib
+
+  _CRC_TABLE = None
+
+  def _crc32c(data: bytes) -> int:
+    # Table-driven CRC32C (Castagnoli). Slow-path fallback only.
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+      poly = 0x82F63B78
+      table = []
+      for i in range(256):
+        crc = i
+        for _ in range(8):
+          crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+      _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+      crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+  crc = _crc32c(data)
+  return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+class TFRecordWriter:
+  """Appends framed records to a file."""
+
+  def __init__(self, path: str):
+    dirname = os.path.dirname(path)
+    if dirname:
+      os.makedirs(dirname, exist_ok=True)
+    self._file = open(path, 'wb')
+
+  def write(self, record: bytes) -> None:
+    length = struct.pack('<Q', len(record))
+    self._file.write(length)
+    self._file.write(struct.pack('<I', _masked_crc(length)))
+    self._file.write(record)
+    self._file.write(struct.pack('<I', _masked_crc(record)))
+
+  def flush(self) -> None:
+    self._file.flush()
+
+  def close(self) -> None:
+    self._file.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *_):
+    self.close()
+
+
+def tfrecord_iterator(path: str,
+                      verify_crc: bool = False) -> Iterator[bytes]:
+  """Yields raw record payloads from one TFRecord file."""
+  with open(path, 'rb') as f:
+    while True:
+      header = f.read(12)
+      if len(header) < 12:
+        return
+      (length,) = struct.unpack('<Q', header[:8])
+      if verify_crc:
+        (expected,) = struct.unpack('<I', header[8:12])
+        if _masked_crc(header[:8]) != expected:
+          raise IOError('Corrupt TFRecord length CRC in {}'.format(path))
+      data = f.read(length)
+      if len(data) < length:
+        raise IOError('Truncated TFRecord in {}'.format(path))
+      footer = f.read(4)
+      if verify_crc:
+        (expected,) = struct.unpack('<I', footer)
+        if _masked_crc(data) != expected:
+          raise IOError('Corrupt TFRecord data CRC in {}'.format(path))
+      yield data
+
+
+def read_all_records(path: str) -> List[bytes]:
+  return list(tfrecord_iterator(path))
+
+
+def write_records(path: str, records) -> None:
+  with TFRecordWriter(path) as writer:
+    for record in records:
+      writer.write(record)

@@ -1,0 +1,16 @@
+"""Run-mode keys shared across the framework (analog of tf.estimator.ModeKeys)."""
+
+
+class ModeKeys:
+  TRAIN = 'train'
+  EVAL = 'eval'
+  PREDICT = 'predict'
+
+  ALL = (TRAIN, EVAL, PREDICT)
+
+
+def assert_valid_mode(mode: str) -> str:
+  if mode not in ModeKeys.ALL:
+    raise ValueError('Invalid mode {!r}; expected one of {}.'.format(
+        mode, ModeKeys.ALL))
+  return mode
