@@ -25,7 +25,11 @@ import numpy as np
 
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data.parser import ExampleParser
-from tensor2robot_tpu.data.pipeline import BatchedExampleStream, RecordDataset
+from tensor2robot_tpu.data.pipeline import (
+    BatchedExampleStream,
+    RecordDataset,
+    parse_file_patterns,
+)
 from tensor2robot_tpu.modes import ModeKeys, assert_valid_mode
 
 
@@ -153,7 +157,6 @@ class FractionalRecordInputGenerator(DefaultRecordInputGenerator):
     out = {}
     for key, patterns in super()._dataset_files().items():
       if self._file_fraction < 1.0:
-        from tensor2robot_tpu.data.pipeline import parse_file_patterns
         _, files = parse_file_patterns(patterns)
         n = max(1, int(self._file_fraction * len(files)))
         patterns = ','.join(files[:n])

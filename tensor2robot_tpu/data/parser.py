@@ -103,6 +103,15 @@ class ExampleParser:
     """Converts one Feature payload to a numpy array per the spec."""
     kind, values = kind_values
     shape = spec.shape
+    if spec.is_encoded_image and not self._decode_images:
+      # Pass encoded bytes through untouched (client-side decode).
+      if kind != 'bytes':
+        raise ValueError('Encoded image {} stored as {}'.format(spec.name, kind))
+      if len(values) == 1 and len(shape) <= 3:
+        return values[0]
+      out = np.empty(len(values), dtype=object)
+      out[:] = values
+      return out
     if self._decode_images and spec.is_encoded_image:
       if kind != 'bytes':
         raise ValueError('Encoded image {} stored as {}'.format(spec.name, kind))
@@ -205,7 +214,12 @@ class ExampleParser:
     for name in names:
       rows = [p[name] for p in parsed if name in p]
       if len(rows) != len(parsed):
-        continue  # optional feature present only in some records: drop.
+        # Optional feature present in only part of the batch: dropped for the
+        # whole batch (a batch is dense). Note: with shuffling this makes the
+        # feature's availability vary batch-to-batch on datasets with partial
+        # coverage; declare such features non-optional (with defaults written
+        # at collection time) if models depend on them.
+        continue
       spec = self._by_name.get(name)
       if spec is not None and spec.is_sequence:
         max_len = max(r.shape[0] for r in rows)
@@ -221,6 +235,8 @@ class ExampleParser:
       batched[name] = np.stack(rows)
     features = self._pack_side(self._feature_spec, batched)
     labels = self._pack_side(self._label_spec, batched)
+    if validate and not self._decode_images:
+      validate = False  # raw encoded bytes intentionally mismatch image specs
     if validate:
       features = specs_lib.validate_and_pack(
           specs_lib.add_sequence_length_specs(self._feature_spec), features,

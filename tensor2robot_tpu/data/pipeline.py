@@ -62,7 +62,9 @@ def _interleaved_records(filenames: List[str], cycle_length: int = 4,
   for _ in range(cycle_length):
     path = next(pending, None)
     if path is not None:
-      active.append(tfrecord.tfrecord_iterator(path))
+      # CRC verification is cheap (C impl) and turns silent shard corruption
+      # into a clear 'Corrupt TFRecord' error instead of misframed garbage.
+      active.append(tfrecord.tfrecord_iterator(path, verify_crc=True))
   while active:
     done = []
     for it in active:

@@ -95,6 +95,8 @@ def tfrecord_iterator(path: str,
       if len(data) < length:
         raise IOError('Truncated TFRecord in {}'.format(path))
       footer = f.read(4)
+      if len(footer) < 4:
+        raise IOError('Truncated TFRecord in {}'.format(path))
       if verify_crc:
         (expected,) = struct.unpack('<I', footer)
         if _masked_crc(data) != expected:

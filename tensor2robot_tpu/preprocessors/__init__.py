@@ -1,0 +1,13 @@
+"""Preprocessors: validated, jittable transforms between data and model specs."""
+
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.noop_preprocessor import NoOpPreprocessor
+from tensor2robot_tpu.preprocessors.spec_transformation_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
+    Bfloat16PreprocessorWrapper,
+)
+from tensor2robot_tpu.preprocessors import image_transformations

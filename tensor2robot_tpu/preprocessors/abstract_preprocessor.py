@@ -1,0 +1,98 @@
+"""Preprocessor protocol: declarative in/out specs around a pure transform.
+
+Parity target: /root/reference/preprocessors/abstract_preprocessor.py:34-223.
+A preprocessor declares four spec structures — in/out × features/labels, per
+mode — and ``preprocess`` runs validate_and_pack → ``_preprocess_fn`` →
+validate_and_flatten on both sides of the transform.
+
+TPU-first redesign: ``_preprocess_fn`` is a *pure jittable function* taking an
+explicit ``rng`` key, so the trainer composes it INSIDE the jitted train step:
+random crops/distortions execute on device, fused by XLA, instead of on host
+CPU as in the reference's tf.data map (utils/tfdata.py:572-574). Validation
+under jit happens at trace time and costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Tuple
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.modes import assert_valid_mode
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class AbstractPreprocessor(abc.ABC):
+  """Base class; subclasses define specs + _preprocess_fn."""
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None):
+    """Optionally binds the model's spec fns (mode -> spec structure).
+
+    ref: abstract_preprocessor.py:42-58 — preprocessors are constructed with
+    the model's spec getters so out-specs can default to the model's needs.
+    """
+    self._model_feature_specification_fn = model_feature_specification_fn
+    self._model_label_specification_fn = model_label_specification_fn
+
+  # -- the four spec declarations -------------------------------------------
+
+  @abc.abstractmethod
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    """What the raw data pipeline must produce (ref :93)."""
+
+  @abc.abstractmethod
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    """ref :105."""
+
+  @abc.abstractmethod
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    """What the model consumes (ref :117)."""
+
+  @abc.abstractmethod
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    """ref :129."""
+
+  def _model_feature_specification(self, mode: str):
+    if self._model_feature_specification_fn is None:
+      raise ValueError(
+          '{} was not constructed with model spec fns.'.format(type(self)))
+    return self._model_feature_specification_fn(mode)
+
+  def _model_label_specification(self, mode: str):
+    if self._model_label_specification_fn is None:
+      raise ValueError(
+          '{} was not constructed with model spec fns.'.format(type(self)))
+    return self._model_label_specification_fn(mode)
+
+  # -- the transform ---------------------------------------------------------
+
+  @abc.abstractmethod
+  def _preprocess_fn(self, features: SpecStruct,
+                     labels: Optional[SpecStruct],
+                     mode: str,
+                     rng=None) -> Tuple[SpecStruct, Optional[SpecStruct]]:
+    """Pure transform; must be jittable (no data-dependent python control flow)."""
+
+  def preprocess(self, features, labels, mode: str,
+                 rng=None) -> Tuple[SpecStruct, Optional[SpecStruct]]:
+    """Validated transform (ref :177-223)."""
+    assert_valid_mode(mode)
+    features = specs_lib.validate_and_pack(
+        self.get_in_feature_specification(mode), features, ignore_batch=True)
+    if labels is not None and len(specs_lib.flatten_spec_structure(
+        self.get_in_label_specification(mode))):
+      labels = specs_lib.validate_and_pack(
+          self.get_in_label_specification(mode), labels, ignore_batch=True)
+    else:
+      labels = None
+    features_out, labels_out = self._preprocess_fn(features, labels, mode, rng)
+    features_out = specs_lib.validate_and_pack(
+        self.get_out_feature_specification(mode), features_out,
+        ignore_batch=True)
+    if labels_out is not None:
+      labels_out = specs_lib.validate_and_pack(
+          self.get_out_label_specification(mode), labels_out,
+          ignore_batch=True)
+    return features_out, labels_out

@@ -1,0 +1,101 @@
+"""bfloat16 feed wrapper: present f32 specs upstream, emit bf16 downstream.
+
+Parity target: /root/reference/preprocessors/tpu_preprocessor_wrapper.py:37-160.
+In the reference this wrapper (plus models/tpu_model_wrapper.py) exists
+because TF1's CPU↔TPU infeed could not carry some dtypes; in JAX, bf16 arrays
+are first-class on both sides, so most models simply declare bf16 specs and
+need none of this. The wrapper remains for models that keep float32 specs but
+want bf16 device math: it
+
+  * presents float32 in-specs to the (host) data pipeline, even where the
+    wrapped preprocessor asks for bfloat16 (ref :78-106);
+  * strips optional tensors from out-specs (TPU infeed had no optionals —
+    kept because it also guarantees a static, dense feed structure, which is
+    what jit wants) and re-casts float32 outputs to bfloat16 (ref :108-160).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec, bfloat16
+
+
+class Bfloat16PreprocessorWrapper(AbstractPreprocessor):
+  """Decorates any preprocessor with f32-in / bf16-out spec re-typing."""
+
+  def __init__(self, preprocessor: AbstractPreprocessor):
+    super().__init__()
+    self._preprocessor = preprocessor
+
+  @property
+  def preprocessor(self) -> AbstractPreprocessor:
+    return self._preprocessor
+
+  def get_in_feature_specification(self, mode):
+    return specs_lib.replace_dtype(
+        self._preprocessor.get_in_feature_specification(mode),
+        bfloat16, np.float32)
+
+  def get_in_label_specification(self, mode):
+    return specs_lib.replace_dtype(
+        self._preprocessor.get_in_label_specification(mode),
+        bfloat16, np.float32)
+
+  def _out_spec(self, spec_structure) -> SpecStruct:
+    required = specs_lib.filter_required_flat_tensor_spec(spec_structure)
+    return specs_lib.replace_dtype(required, np.float32, bfloat16)
+
+  def get_out_feature_specification(self, mode):
+    return self._out_spec(
+        self._preprocessor.get_out_feature_specification(mode))
+
+  def get_out_label_specification(self, mode):
+    return self._out_spec(self._preprocessor.get_out_label_specification(mode))
+
+  def _preprocess_fn(self, features, labels, mode, rng=None):
+    features, labels = self._preprocessor._preprocess_fn(  # pylint: disable=protected-access
+        features, labels, mode, rng)
+    features = self._cast(features,
+                          self.get_out_feature_specification(mode))
+    if labels is not None:
+      labels = self._cast(labels, self.get_out_label_specification(mode))
+    return features, labels
+
+  def _cast(self, tensors, out_spec) -> SpecStruct:
+    """Keeps required tensors, casting f32->bf16 where the out-spec says so."""
+    flat_spec = specs_lib.flatten_spec_structure(out_spec)
+    flat = specs_lib.flatten_spec_structure(tensors)
+    out = SpecStruct()
+    for key in flat_spec:
+      if key not in flat:
+        continue
+      value = flat[key]
+      if flat_spec[key].dtype == bfloat16:
+        import jax.numpy as jnp
+        value = jnp.asarray(value).astype(bfloat16)
+      out[key] = value
+    return out
+
+  def preprocess(self, features, labels, mode, rng=None):
+    # Validate against the wrapped f32 in-specs, then transform + cast.
+    features = specs_lib.validate_and_pack(
+        self.get_in_feature_specification(mode), features, ignore_batch=True)
+    if labels is not None and len(specs_lib.flatten_spec_structure(
+        self.get_in_label_specification(mode))):
+      labels = specs_lib.validate_and_pack(
+          self.get_in_label_specification(mode), labels, ignore_batch=True)
+    else:
+      labels = None
+    features, labels = self._preprocess_fn(features, labels, mode, rng)
+    features = specs_lib.validate_and_pack(
+        self.get_out_feature_specification(mode), features, ignore_batch=True)
+    if labels is not None:
+      labels = specs_lib.validate_and_pack(
+          self.get_out_label_specification(mode), labels, ignore_batch=True)
+    return features, labels

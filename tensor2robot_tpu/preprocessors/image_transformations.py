@@ -1,0 +1,225 @@
+"""Jittable image transformations (crops, photometric & depth distortions).
+
+Parity target: /root/reference/preprocessors/image_transformations.py:31-332.
+All functions are pure JAX on float32/bfloat16 images in [0, 1], NHWC, and
+take explicit PRNG keys, so they run *on device inside the jitted train step*
+(XLA fuses the elementwise chains) instead of host-side tf.data maps.
+
+Multi-view alignment: like the reference, the Random/Center crop functions
+take a *list* of image batches and apply identical offsets to every view of
+the same example, keeping stereo/dual-camera inputs registered.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_shapes(images: Sequence[jnp.ndarray]) -> None:
+  if not images:
+    raise ValueError('Need at least one image batch.')
+
+
+def crop_images(images: List[jnp.ndarray], offsets,
+                target_shape: Tuple[int, int]) -> List[jnp.ndarray]:
+  """Crops each [B,H,W,C] batch at per-example (y, x) offsets (ref :110).
+
+  ``offsets``: int array [B, 2]. Uses per-example dynamic slices via vmap —
+  static target shape keeps XLA happy.
+  """
+  _check_shapes(images)
+  th, tw = target_shape
+
+  def _crop_one(img, off):
+    return jax.lax.dynamic_slice(
+        img, (off[0], off[1], 0), (th, tw, img.shape[-1]))
+
+  return [jax.vmap(_crop_one)(img, offsets) for img in images]
+
+
+def random_crop_images(key: jax.Array, images: List[jnp.ndarray],
+                       target_shape: Tuple[int, int]) -> List[jnp.ndarray]:
+  """Random crop, identical offsets across views of one example (ref :31)."""
+  _check_shapes(images)
+  batch, height, width = images[0].shape[0], images[0].shape[1], images[0].shape[2]
+  th, tw = target_shape
+  if th > height or tw > width:
+    raise ValueError('Crop {} exceeds image size {}.'.format(
+        target_shape, (height, width)))
+  ky, kx = jax.random.split(key)
+  ys = jax.random.randint(ky, (batch,), 0, height - th + 1)
+  xs = jax.random.randint(kx, (batch,), 0, width - tw + 1)
+  offsets = jnp.stack([ys, xs], axis=-1)
+  return crop_images(images, offsets, target_shape)
+
+
+def center_crop_images(images: List[jnp.ndarray],
+                       target_shape: Tuple[int, int]) -> List[jnp.ndarray]:
+  """Deterministic center crop (ref :68)."""
+  _check_shapes(images)
+  height, width = images[0].shape[1], images[0].shape[2]
+  th, tw = target_shape
+  y0, x0 = (height - th) // 2, (width - tw) // 2
+  return [img[:, y0:y0 + th, x0:x0 + tw, :] for img in images]
+
+
+# -- photometric distortions -------------------------------------------------
+
+_RGB_TO_GRAY = jnp.asarray([0.299, 0.587, 0.114])
+
+
+def rgb_to_hsv(image: jnp.ndarray) -> jnp.ndarray:
+  """[..., 3] RGB in [0,1] -> HSV, matching tf.image.rgb_to_hsv semantics."""
+  r, g, b = image[..., 0], image[..., 1], image[..., 2]
+  maxc = jnp.maximum(jnp.maximum(r, g), b)
+  minc = jnp.minimum(jnp.minimum(r, g), b)
+  value = maxc
+  delta = maxc - minc
+  safe_delta = jnp.where(delta == 0, 1.0, delta)
+  saturation = jnp.where(maxc == 0, 0.0, delta / jnp.where(maxc == 0, 1.0, maxc))
+  hue_r = ((g - b) / safe_delta) % 6.0
+  hue_g = (b - r) / safe_delta + 2.0
+  hue_b = (r - g) / safe_delta + 4.0
+  hue = jnp.where(maxc == r, hue_r, jnp.where(maxc == g, hue_g, hue_b))
+  hue = jnp.where(delta == 0, 0.0, hue / 6.0)
+  return jnp.stack([hue, saturation, value], axis=-1)
+
+
+def hsv_to_rgb(image: jnp.ndarray) -> jnp.ndarray:
+  """[..., 3] HSV -> RGB in [0,1]."""
+  h, s, v = image[..., 0], image[..., 1], image[..., 2]
+  h6 = h * 6.0
+  c = v * s
+  x = c * (1.0 - jnp.abs(h6 % 2.0 - 1.0))
+  zeros = jnp.zeros_like(c)
+  idx = jnp.floor(h6).astype(jnp.int32) % 6
+  r = jnp.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4, idx == 5],
+                 [c, x, zeros, zeros, x, c])
+  g = jnp.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4, idx == 5],
+                 [x, c, c, x, zeros, zeros])
+  b = jnp.select([idx == 0, idx == 1, idx == 2, idx == 3, idx == 4, idx == 5],
+                 [zeros, zeros, x, c, c, x])
+  m = v - c
+  return jnp.stack([r + m, g + m, b + m], axis=-1)
+
+
+def adjust_brightness(image: jnp.ndarray, delta) -> jnp.ndarray:
+  return image + delta
+
+
+def adjust_contrast(image: jnp.ndarray, factor) -> jnp.ndarray:
+  mean = jnp.mean(image, axis=(-3, -2), keepdims=True)
+  return (image - mean) * factor + mean
+
+
+def adjust_saturation(image: jnp.ndarray, factor) -> jnp.ndarray:
+  gray = jnp.tensordot(image, _RGB_TO_GRAY, axes=[[-1], [0]],
+                       precision=jax.lax.Precision.HIGHEST)[..., None]
+  return gray + (image - gray) * factor
+
+
+def adjust_hue(image: jnp.ndarray, delta) -> jnp.ndarray:
+  """Circular hue shift by ``delta`` turns (tf.image.adjust_hue semantics).
+
+  Pure elementwise HSV round trip — XLA fuses the whole chain, so on TPU this
+  costs one pass over the image, no matmul.
+  """
+  hsv = rgb_to_hsv(image)
+  hue = (hsv[..., 0] + delta) % 1.0
+  return hsv_to_rgb(jnp.stack([hue, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+def apply_photometric_image_distortions(
+    key: jax.Array,
+    images: List[jnp.ndarray],
+    random_brightness: bool = False,
+    max_delta_brightness: float = 0.125,
+    random_saturation: bool = False,
+    lower_saturation: float = 0.5,
+    upper_saturation: float = 1.5,
+    random_hue: bool = False,
+    max_delta_hue: float = 0.2,
+    random_contrast: bool = False,
+    lower_contrast: float = 0.5,
+    upper_contrast: float = 1.5,
+    random_noise_level: float = 0.0,
+    random_noise_apply_probability: float = 0.5,
+    random_channel_swap: bool = False,
+) -> List[jnp.ndarray]:
+  """Per-example random photometric jitter on [0,1] images (ref :182-273).
+
+  Each image batch in ``images`` is distorted independently (unlike crops,
+  photometric jitter need not be aligned across views — reference parity).
+  """
+  out = []
+  for img in images:
+    batch = img.shape[0]
+    if random_brightness:
+      key, sub = jax.random.split(key)
+      delta = jax.random.uniform(sub, (batch, 1, 1, 1),
+                                 minval=-max_delta_brightness,
+                                 maxval=max_delta_brightness)
+      img = adjust_brightness(img, delta)
+    if random_saturation:
+      key, sub = jax.random.split(key)
+      factor = jax.random.uniform(sub, (batch, 1, 1, 1),
+                                  minval=lower_saturation,
+                                  maxval=upper_saturation)
+      img = adjust_saturation(img, factor)
+    if random_hue:
+      key, sub = jax.random.split(key)
+      delta = jax.random.uniform(sub, (batch,), minval=-max_delta_hue,
+                                 maxval=max_delta_hue)
+      img = jax.vmap(adjust_hue)(img, delta)
+    if random_contrast:
+      key, sub = jax.random.split(key)
+      factor = jax.random.uniform(sub, (batch, 1, 1, 1),
+                                  minval=lower_contrast, maxval=upper_contrast)
+      img = adjust_contrast(img, factor)
+    if random_noise_level:
+      key, knoise, kapply = jax.random.split(key, 3)
+      noise = jax.random.normal(knoise, img.shape, img.dtype) * random_noise_level
+      apply = (jax.random.uniform(kapply, (batch, 1, 1, 1))
+               < random_noise_apply_probability)
+      img = jnp.where(apply, img + noise, img)
+    if random_channel_swap:
+      key, sub = jax.random.split(key)
+      # All 6 permutations of RGB; pick one per example.
+      perms = jnp.asarray([[0, 1, 2], [0, 2, 1], [1, 0, 2],
+                           [1, 2, 0], [2, 0, 1], [2, 1, 0]])
+      choice = jax.random.randint(sub, (batch,), 0, perms.shape[0])
+      img = jax.vmap(lambda im, p: im[..., p])(img, perms[choice])
+    img = jnp.clip(img, 0.0, 1.0)
+    out.append(img)
+  return out
+
+
+def apply_depth_image_distortions(
+    key: jax.Array,
+    depth_images: List[jnp.ndarray],
+    random_noise_level: float = 0.05,
+    random_noise_apply_probability: float = 0.5,
+    scale_noise: bool = False,
+    lower_scale: float = 0.8,
+    upper_scale: float = 1.2,
+) -> List[jnp.ndarray]:
+  """Gaussian / scale noise on [B,H,W,1] depth maps (ref :276-332)."""
+  out = []
+  for img in depth_images:
+    batch = img.shape[0]
+    if random_noise_level:
+      key, knoise, kapply = jax.random.split(key, 3)
+      noise = jax.random.normal(knoise, img.shape, img.dtype) * random_noise_level
+      apply = (jax.random.uniform(kapply, (batch, 1, 1, 1))
+               < random_noise_apply_probability)
+      img = jnp.where(apply, img + noise, img)
+    if scale_noise:
+      key, sub = jax.random.split(key)
+      scale = jax.random.uniform(sub, (batch, 1, 1, 1), minval=lower_scale,
+                                 maxval=upper_scale)
+      img = img * scale
+    out.append(img)
+  return out

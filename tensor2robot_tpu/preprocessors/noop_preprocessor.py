@@ -1,0 +1,33 @@
+"""Identity preprocessor — the default for every model.
+
+Parity: /root/reference/preprocessors/noop_preprocessor.py:32 — in-specs equal
+the model's specs (with sequence-length companions added), and the transform
+is the identity.
+"""
+
+from __future__ import annotations
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+
+
+class NoOpPreprocessor(AbstractPreprocessor):
+
+  def get_in_feature_specification(self, mode):
+    return specs_lib.add_sequence_length_specs(
+        self._model_feature_specification(mode))
+
+  def get_in_label_specification(self, mode):
+    return specs_lib.add_sequence_length_specs(
+        self._model_label_specification(mode))
+
+  def get_out_feature_specification(self, mode):
+    return self.get_in_feature_specification(mode)
+
+  def get_out_label_specification(self, mode):
+    return self.get_in_label_specification(mode)
+
+  def _preprocess_fn(self, features, labels, mode, rng=None):
+    return features, labels

@@ -1,0 +1,46 @@
+"""Preprocessor whose in-specs are derived by transforming the model's specs.
+
+Parity: /root/reference/preprocessors/spec_transformation_preprocessor.py:30.
+Subclasses override ``update_spec_transform`` to declare how each model
+(out) spec looks on disk — e.g. the model wants a float32 (H, W, 3) image but
+the dataset stores jpeg bytes at a different resolution.
+"""
+
+from __future__ import annotations
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+class SpecTransformationPreprocessor(AbstractPreprocessor):
+
+  def update_spec_transform(self, key: str, spec: TensorSpec,
+                            mode: str) -> TensorSpec:
+    """Maps one model spec to its on-disk (in) spec. Default: identity."""
+    del key, mode
+    return spec
+
+  def _transform(self, spec_structure, mode: str) -> SpecStruct:
+    flat = specs_lib.flatten_spec_structure(spec_structure)
+    out = SpecStruct()
+    for key in flat:
+      out[key] = self.update_spec_transform(key, flat[key], mode)
+    return specs_lib.add_sequence_length_specs(out)
+
+  def get_in_feature_specification(self, mode):
+    return self._transform(self._model_feature_specification(mode), mode)
+
+  def get_in_label_specification(self, mode):
+    return self._transform(self._model_label_specification(mode), mode)
+
+  def get_out_feature_specification(self, mode):
+    return specs_lib.add_sequence_length_specs(
+        self._model_feature_specification(mode))
+
+  def get_out_label_specification(self, mode):
+    return specs_lib.add_sequence_length_specs(
+        self._model_label_specification(mode))

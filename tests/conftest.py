@@ -7,7 +7,11 @@ TPU-without-TPU estimator tests).
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force-override: the ambient environment pins JAX_PLATFORMS=axon (the
+# tunneled TPU). Tests must run on the virtual CPU mesh — the TPU tunnel
+# serializes every process behind a single-chip lease, so accidentally
+# running the suite there both slows it ~10x and wedges concurrent work.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in xla_flags:
   os.environ['XLA_FLAGS'] = (

@@ -1,0 +1,214 @@
+"""Preprocessor tests: protocol, spec wrappers, jittable image transforms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors import (
+    AbstractPreprocessor,
+    Bfloat16PreprocessorWrapper,
+    NoOpPreprocessor,
+    SpecTransformationPreprocessor,
+    image_transformations,
+)
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec, bfloat16
+
+
+def _model_feature_spec(mode):
+  del mode
+  s = SpecStruct()
+  s['image'] = TensorSpec((16, 16, 3), np.float32, name='image')
+  s['state'] = TensorSpec((4,), np.float32, name='state')
+  return s
+
+
+def _model_label_spec(mode):
+  del mode
+  return SpecStruct(target=TensorSpec((2,), np.float32, name='target'))
+
+
+class TestNoOpPreprocessor:
+
+  def test_identity_with_validation(self):
+    p = NoOpPreprocessor(_model_feature_spec, _model_label_spec)
+    features = specs_lib.make_random_numpy(
+        p.get_in_feature_specification(ModeKeys.TRAIN), batch_size=2)
+    labels = specs_lib.make_random_numpy(
+        p.get_in_label_specification(ModeKeys.TRAIN), batch_size=2)
+    f, l = p.preprocess(features, labels, ModeKeys.TRAIN)
+    np.testing.assert_array_equal(f['image'], features['image'])
+    np.testing.assert_array_equal(l['target'], labels['target'])
+
+  def test_rejects_bad_input(self):
+    p = NoOpPreprocessor(_model_feature_spec, _model_label_spec)
+    with pytest.raises(ValueError, match='Required'):
+      p.preprocess(SpecStruct(), None, ModeKeys.PREDICT)
+
+
+class TestSpecTransformationPreprocessor:
+
+  class _JpegOnDisk(SpecTransformationPreprocessor):
+    def update_spec_transform(self, key, spec, mode):
+      if 'image' in key:
+        return TensorSpec(spec.shape, np.uint8, name=spec.name,
+                          data_format='jpeg')
+      return spec
+
+    def _preprocess_fn(self, features, labels, mode, rng=None):
+      features['image'] = features['image'].astype(np.float32) / 255.0
+      return features, labels
+
+  def test_in_spec_transformed_out_matches_model(self):
+    p = self._JpegOnDisk(_model_feature_spec, _model_label_spec)
+    in_spec = p.get_in_feature_specification(ModeKeys.TRAIN)
+    assert in_spec['image'].dtype == np.uint8
+    assert in_spec['image'].data_format == 'jpeg'
+    out_spec = p.get_out_feature_specification(ModeKeys.TRAIN)
+    assert out_spec['image'].dtype == np.float32
+    features = specs_lib.make_random_numpy(in_spec, batch_size=2)
+    labels = specs_lib.make_random_numpy(
+        p.get_in_label_specification(ModeKeys.TRAIN), batch_size=2)
+    f, _ = p.preprocess(features, labels, ModeKeys.TRAIN)
+    assert f['image'].dtype == np.float32
+
+
+class TestBfloat16Wrapper:
+
+  def test_spec_retyping_and_cast(self):
+    base = NoOpPreprocessor(_model_feature_spec, _model_label_spec)
+    wrapped = Bfloat16PreprocessorWrapper(base)
+    in_spec = wrapped.get_in_feature_specification(ModeKeys.TRAIN)
+    assert in_spec['image'].dtype == np.float32
+    out_spec = wrapped.get_out_feature_specification(ModeKeys.TRAIN)
+    assert out_spec['image'].dtype == bfloat16
+    features = specs_lib.make_random_numpy(in_spec, batch_size=2)
+    labels = specs_lib.make_random_numpy(
+        wrapped.get_in_label_specification(ModeKeys.TRAIN), batch_size=2)
+    f, l = wrapped.preprocess(features, labels, ModeKeys.TRAIN)
+    assert f['image'].dtype == bfloat16
+    assert l['target'].dtype == bfloat16
+
+  def test_optional_stripped(self):
+    def fs(mode):
+      s = _model_feature_spec(mode)
+      s['extra'] = TensorSpec((1,), np.float32, name='extra', is_optional=True)
+      return s
+    wrapped = Bfloat16PreprocessorWrapper(NoOpPreprocessor(fs, _model_label_spec))
+    out_spec = wrapped.get_out_feature_specification(ModeKeys.TRAIN)
+    assert 'extra' not in out_spec
+
+
+class TestImageTransformations:
+
+  def _images(self, n=2, h=16, w=16):
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.rand(n, h, w, 3).astype(np.float32))
+
+  def test_center_crop(self):
+    img = self._images()
+    (out,) = image_transformations.center_crop_images([img], (8, 8))
+    assert out.shape == (2, 8, 8, 3)
+    np.testing.assert_allclose(out, img[:, 4:12, 4:12, :])
+
+  def test_random_crop_aligned_across_views(self):
+    img = self._images()
+    key = jax.random.PRNGKey(0)
+    a, b = image_transformations.random_crop_images(key, [img, img], (8, 8))
+    np.testing.assert_allclose(a, b)  # identical offsets per example
+    assert a.shape == (2, 8, 8, 3)
+
+  def test_random_crop_bounds(self):
+    img = self._images()
+    with pytest.raises(ValueError, match='exceeds'):
+      image_transformations.random_crop_images(
+          jax.random.PRNGKey(0), [img], (32, 32))
+
+  def test_random_crop_content_is_a_window(self):
+    img = self._images(n=1, h=6, w=6)
+    key = jax.random.PRNGKey(3)
+    (out,) = image_transformations.random_crop_images(key, [img], (3, 3))
+    # The crop must appear somewhere in the source image.
+    found = False
+    for y in range(4):
+      for x in range(4):
+        if np.allclose(out[0], img[0, y:y + 3, x:x + 3]):
+          found = True
+    assert found
+
+  def test_photometric_jittable_and_bounded(self):
+    img = self._images()
+    key = jax.random.PRNGKey(1)
+
+    @jax.jit
+    def distort(key, img):
+      return image_transformations.apply_photometric_image_distortions(
+          key, [img], random_brightness=True, random_saturation=True,
+          random_hue=True, random_contrast=True, random_noise_level=0.05,
+          random_channel_swap=True)[0]
+
+    out = distort(key, img)
+    assert out.shape == img.shape
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+    assert not np.allclose(out, img)
+    # Deterministic per key.
+    np.testing.assert_allclose(distort(key, img), out)
+
+  def test_hue_identity_at_zero(self):
+    img = self._images()
+    out = image_transformations.adjust_hue(img, 0.0)
+    np.testing.assert_allclose(out, img, atol=1e-5)
+
+  def test_hue_matches_tf(self):
+    tf = pytest.importorskip('tensorflow')
+    img = self._images(n=1)
+    for delta in (0.07, -0.2, 0.45):
+      ours = image_transformations.adjust_hue(img, delta)
+      theirs = tf.image.adjust_hue(tf.constant(np.asarray(img)), delta).numpy()
+      assert np.max(np.abs(np.asarray(ours) - theirs)) < 1e-4, delta
+
+  def test_depth_distortions(self):
+    depth = jnp.ones((2, 8, 8, 1), jnp.float32)
+    (out,) = image_transformations.apply_depth_image_distortions(
+        jax.random.PRNGKey(0), [depth], random_noise_level=0.1,
+        scale_noise=True)
+    assert out.shape == depth.shape
+    assert not np.allclose(out, depth)
+
+  def test_preprocess_inside_jit_with_rng(self):
+    """The whole preprocessor protocol composes under jit (device-side)."""
+
+    class CropPreprocessor(AbstractPreprocessor):
+      def get_in_feature_specification(self, mode):
+        return SpecStruct(image=TensorSpec((16, 16, 3), np.float32,
+                                           name='image'))
+
+      def get_in_label_specification(self, mode):
+        return SpecStruct()
+
+      def get_out_feature_specification(self, mode):
+        return SpecStruct(image=TensorSpec((8, 8, 3), np.float32,
+                                           name='image'))
+
+      def get_out_label_specification(self, mode):
+        return SpecStruct()
+
+      def _preprocess_fn(self, features, labels, mode, rng=None):
+        out = SpecStruct()
+        (out['image'],) = image_transformations.random_crop_images(
+            rng, [features['image']], (8, 8))
+        return out, labels
+
+    p = CropPreprocessor()
+
+    @jax.jit
+    def step(features, rng):
+      f, _ = p.preprocess(features, None, ModeKeys.TRAIN, rng)
+      return jnp.mean(f['image'])
+
+    features = specs_lib.make_random_numpy(
+        p.get_in_feature_specification(ModeKeys.TRAIN), batch_size=4)
+    value = step(features, jax.random.PRNGKey(0))
+    assert np.isfinite(float(value))
