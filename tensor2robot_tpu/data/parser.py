@@ -220,6 +220,13 @@ class ExampleParser:
         # coverage; declare such features non-optional (with defaults written
         # at collection time) if models depend on them.
         continue
+      if isinstance(rows[0], bytes):
+        # Bare bytes rows: np.stack would coerce to fixed-width 'S' dtype,
+        # silently stripping trailing NULs; keep dtype=object instead.
+        arr = np.empty(len(rows), dtype=object)
+        arr[:] = rows
+        batched[name] = arr
+        continue
       spec = self._by_name.get(name)
       if spec is not None and spec.is_sequence:
         max_len = max(r.shape[0] for r in rows)
@@ -235,17 +242,31 @@ class ExampleParser:
       batched[name] = np.stack(rows)
     features = self._pack_side(self._feature_spec, batched)
     labels = self._pack_side(self._label_spec, batched)
-    if validate and not self._decode_images:
-      validate = False  # raw encoded bytes intentionally mismatch image specs
     if validate:
-      features = specs_lib.validate_and_pack(
-          specs_lib.add_sequence_length_specs(self._feature_spec), features,
-          ignore_batch=True)
+      features = self._validate_side(self._feature_spec, features)
       if len(self._label_spec):
-        labels = specs_lib.validate_and_pack(
-            specs_lib.add_sequence_length_specs(self._label_spec), labels,
-            ignore_batch=True)
+        labels = self._validate_side(self._label_spec, labels)
     return features, labels
+
+  def _validate_side(self, side_spec, tensors) -> SpecStruct:
+    spec = specs_lib.add_sequence_length_specs(side_spec)
+    if not self._decode_images:
+      # Raw encoded bytes intentionally mismatch image specs; validate the
+      # rest and carry the image tensors through unvalidated.
+      checked = SpecStruct()
+      passthrough = SpecStruct()
+      flat = specs_lib.flatten_spec_structure(spec)
+      for key in flat:
+        if flat[key].is_encoded_image:
+          if key in tensors:
+            passthrough[key] = tensors[key]
+        else:
+          checked[key] = flat[key]
+      out = specs_lib.validate_and_pack(checked, tensors, ignore_batch=True)
+      for key in passthrough:
+        out[key] = passthrough[key]
+      return out
+    return specs_lib.validate_and_pack(spec, tensors, ignore_batch=True)
 
   def _pack_side(self, side_spec, batched_by_name) -> SpecStruct:
     out = SpecStruct()

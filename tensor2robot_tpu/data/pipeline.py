@@ -77,7 +77,7 @@ def _interleaved_records(filenames: List[str], cycle_length: int = 4,
       active.remove(it)
       path = next(pending, None)
       if path is not None:
-        active.append(tfrecord.tfrecord_iterator(path))
+        active.append(tfrecord.tfrecord_iterator(path, verify_crc=True))
 
 
 def _shuffled(records: Iterator[bytes], buffer_size: int,

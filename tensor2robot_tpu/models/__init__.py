@@ -1,0 +1,8 @@
+"""Model abstraction: spec-declaring models as pure functions + TrainState."""
+
+from tensor2robot_tpu.models.model_interface import ModelInterface
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, TrainState
+from tensor2robot_tpu.models.classification_model import ClassificationModel
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.models import optimizers

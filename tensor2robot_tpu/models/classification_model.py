@@ -1,0 +1,60 @@
+"""ClassificationModel: binary classification base (sigmoid log-loss).
+
+Parity target: /root/reference/models/classification_model.py:48-242.
+Subclasses declare specs and a network producing ``outputs['logits']``;
+labels carry a {0,1} target under ``self.label_key``. Eval metrics mirror
+the reference's mse/accuracy/precision/recall set (:203-242).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class ClassificationModel(AbstractT2RModel):
+
+  label_key = 'target'
+  logits_key = 'logits'
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    logits = inference_outputs[self.logits_key]
+    targets = jnp.asarray(labels[self.label_key], logits.dtype).reshape(
+        logits.shape)
+    loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, targets))
+    return loss, SpecStruct()
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    logits = inference_outputs[self.logits_key]
+    targets = jnp.asarray(labels[self.label_key], logits.dtype).reshape(
+        logits.shape)
+    probabilities = jnp.asarray(
+        jnp.reshape(jnp.float32(1) / (1 + jnp.exp(-logits.astype(jnp.float32))),
+                    logits.shape))
+    predictions = (probabilities > 0.5).astype(jnp.float32)
+    targets_f = targets.astype(jnp.float32)
+    true_positives = jnp.sum(predictions * targets_f)
+    eps = 1e-8
+    metrics = SpecStruct()
+    metrics['loss'] = jnp.mean(
+        optax.sigmoid_binary_cross_entropy(logits, targets))
+    metrics['mean_squared_error'] = jnp.mean(
+        (probabilities - targets_f) ** 2)
+    metrics['accuracy'] = jnp.mean((predictions == targets_f).astype(
+        jnp.float32))
+    metrics['precision'] = true_positives / (jnp.sum(predictions) + eps)
+    metrics['recall'] = true_positives / (jnp.sum(targets_f) + eps)
+    return metrics
+
+  def create_export_outputs_fn(self, features, inference_outputs,
+                               mode: str) -> SpecStruct:
+    logits = inference_outputs[self.logits_key]
+    out = SpecStruct()
+    out[self.logits_key] = logits
+    out['probabilities'] = 1.0 / (1.0 + jnp.exp(-logits.astype(jnp.float32)))
+    return out

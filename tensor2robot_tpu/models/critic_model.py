@@ -1,0 +1,102 @@
+"""CriticModel: Q(state, action) base for off-policy RL (QT-Opt style).
+
+Parity target: /root/reference/models/critic_model.py:48-243. Subclasses
+declare separate state and action specs (:77-93) and a network producing
+``outputs['q_predicted']``. For CEM-based serving the predict path tiles the
+state across an action batch (``action_batch_size``, :128-141): the robot
+sends one state plus N candidate actions and gets N Q-values back in a single
+device call — on TPU this keeps the MXU busy with one batched forward pass.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import algebra
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class CriticModel(AbstractT2RModel):
+
+  q_key = 'q_predicted'
+  reward_key = 'reward'
+
+  def __init__(self, action_batch_size: Optional[int] = None, **kwargs):
+    """action_batch_size: CEM sample count served per predict call."""
+    super().__init__(**kwargs)
+    self._action_batch_size = action_batch_size
+
+  # -- spec split -----------------------------------------------------------
+
+  @abc.abstractmethod
+  def get_state_specification(self) -> SpecStruct:
+    """ref critic_model.py:77."""
+
+  @abc.abstractmethod
+  def get_action_specification(self) -> SpecStruct:
+    """ref critic_model.py:85."""
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    """state/ + action/ merged (ref :93)."""
+    del mode
+    spec = SpecStruct()
+    for key, sub in (('state', self.get_state_specification()),
+                     ('action', self.get_action_specification())):
+      flat = algebra.flatten_spec_structure(sub)
+      for k in flat:
+        spec[key + '/' + k] = flat[k]
+    return spec
+
+  @property
+  def action_batch_size(self) -> Optional[int]:
+    return self._action_batch_size
+
+  # -- default loss: cross entropy against in-[0,1] targets -----------------
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    q_predicted = inference_outputs[self.q_key]
+    targets = jnp.asarray(labels[self.reward_key],
+                          q_predicted.dtype).reshape(q_predicted.shape)
+    loss = jnp.mean(optax.sigmoid_binary_cross_entropy(
+        self.logit_of(inference_outputs), targets))
+    return loss, SpecStruct()
+
+  def logit_of(self, inference_outputs):
+    """Networks may emit logits alongside q=sigmoid(logits)."""
+    if 'q_logits' in inference_outputs:
+      return inference_outputs['q_logits']
+    q = jnp.clip(inference_outputs[self.q_key], 1e-6, 1 - 1e-6)
+    return jnp.log(q) - jnp.log1p(-q)
+
+  # -- CEM serving ----------------------------------------------------------
+
+  def tile_state_for_action_batch(self, features: SpecStruct) -> SpecStruct:
+    """Expands state [B, ...] to [B*action_batch_size, ...] (ref :128-141).
+
+    The predictor feeds one state and ``action_batch_size`` candidate
+    actions; the network then scores them in one batched forward.
+    """
+    if self._action_batch_size is None:
+      return features
+    tiled = SpecStruct()
+    for key in algebra.flatten_spec_structure(features):
+      value = features[key]
+      if key.startswith('state/'):
+        reps = (self._action_batch_size,) + (1,) * (value.ndim - 1)
+        value = jnp.tile(value, reps)
+      tiled[key] = value
+    return tiled
+
+  def predict_step(self, state, features) -> SpecStruct:
+    features = self.tile_state_for_action_batch(features)
+    variables = state.variables(use_avg_params=self.use_avg_model_params)
+    outputs, _ = self.inference_network_fn(variables, features, None,
+                                           ModeKeys.PREDICT, None)
+    return self.create_export_outputs_fn(features, outputs, ModeKeys.PREDICT)

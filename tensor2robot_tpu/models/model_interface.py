@@ -1,0 +1,89 @@
+"""ModelInterface: the minimal protocol the training/serving infra needs.
+
+Parity target: /root/reference/models/model_interface.py:53-151. The infra
+(trainer, input generators, exporters, predictors) programs against this
+interface, never against concrete models.
+
+TPU-native redesign: instead of an Estimator ``model_fn`` returning
+EstimatorSpecs, the interface exposes *pure functions* over explicit
+parameters — ``init_variables`` / ``inference_network_fn`` /
+``model_train_fn`` / ``model_eval_fn`` — which the trainer composes into one
+jitted, mesh-sharded train step. Model instances hold configuration only;
+all state (params, batch stats, optimizer slots) lives in the TrainState
+pytree the trainer owns.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class ModelInterface(abc.ABC):
+  """What the infra requires of every model."""
+
+  # -- specs ----------------------------------------------------------------
+
+  @abc.abstractmethod
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    ...
+
+  def get_feature_specification_for_packing(self, mode: str) -> SpecStruct:
+    """Specs after preprocessing — what inference_network_fn consumes."""
+    return self.preprocessor.get_out_feature_specification(mode)
+
+  def get_label_specification_for_packing(self, mode: str) -> SpecStruct:
+    return self.preprocessor.get_out_label_specification(mode)
+
+  # -- preprocessor ---------------------------------------------------------
+
+  @property
+  @abc.abstractmethod
+  def preprocessor(self):
+    ...
+
+  # -- pure model functions -------------------------------------------------
+
+  @abc.abstractmethod
+  def init_variables(self, rng, features: SpecStruct,
+                     labels: Optional[SpecStruct], mode: str):
+    """Creates the variable collections pytree for this model."""
+
+  @abc.abstractmethod
+  def inference_network_fn(self, variables, features: SpecStruct,
+                           labels: Optional[SpecStruct], mode: str,
+                           rng=None):
+    """Forward pass. Returns (outputs SpecStruct, updated_variables)."""
+
+  @abc.abstractmethod
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """Returns (scalar loss, train_outputs dict)."""
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    """Returns a dict of per-batch metric values (averaged by the harness)."""
+    del variables, features, inference_outputs
+    return SpecStruct()
+
+  def create_export_outputs_fn(self, features, inference_outputs,
+                               mode: str) -> SpecStruct:
+    """Predictions served at inference time. Default: inference outputs."""
+    del features, mode
+    return inference_outputs
+
+  # -- device / precision ---------------------------------------------------
+
+  @property
+  def device_type(self) -> str:
+    return 'tpu'
+
+  @property
+  def is_device_tpu(self) -> bool:
+    return self.device_type == 'tpu'

@@ -1,0 +1,83 @@
+"""Optimizer and learning-rate factories (optax).
+
+Parity target: /root/reference/models/optimizers.py:29-168. The reference's
+MovingAverageOptimizer + swapping-saver machinery (:141-168) collapses into
+``optax.ema`` tracked alongside the optimizer state: checkpoints carry both
+raw and averaged params, and eval/serving read the averaged ones
+(``use_avg_model_params`` on the model, ref models/abstract_model.py:836-844).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+ScalarOrSchedule = Union[float, Callable[[int], float]]
+
+
+# -- learning rates ----------------------------------------------------------
+
+def create_constant_learning_rate(learning_rate: float = 1e-4):
+  """ref: optimizers.py:46."""
+  return optax.constant_schedule(learning_rate)
+
+
+def create_exponential_decay_learning_rate(
+    initial_learning_rate: float = 1e-4,
+    decay_steps: int = 10000,
+    decay_rate: float = 0.9,
+    staircase: bool = True):
+  """ref: optimizers.py:52."""
+  return optax.exponential_decay(
+      init_value=initial_learning_rate, transition_steps=decay_steps,
+      decay_rate=decay_rate, staircase=staircase)
+
+
+def piecewise_constant_learning_rate(boundaries, values):
+  boundaries_and_scales = {}
+  prev = values[0]
+  for boundary, value in zip(boundaries, values[1:]):
+    boundaries_and_scales[int(boundary)] = value / prev
+    prev = value
+  return optax.piecewise_constant_schedule(values[0], boundaries_and_scales)
+
+
+# -- optimizers --------------------------------------------------------------
+
+def create_adam_optimizer(learning_rate: ScalarOrSchedule = 1e-4,
+                          beta1: float = 0.9, beta2: float = 0.999,
+                          epsilon: float = 1e-8):
+  """ref: optimizers.py:29."""
+  return optax.adam(learning_rate, b1=beta1, b2=beta2, eps=epsilon)
+
+
+def create_sgd_optimizer(learning_rate: ScalarOrSchedule = 1e-4):
+  """ref: optimizers.py:36."""
+  return optax.sgd(learning_rate)
+
+
+def create_momentum_optimizer(learning_rate: ScalarOrSchedule = 1e-4,
+                              momentum: float = 0.9,
+                              use_nesterov: bool = False):
+  """ref: optimizers.py:39."""
+  return optax.sgd(learning_rate, momentum=momentum, nesterov=use_nesterov)
+
+
+def create_rms_prop_optimizer(learning_rate: ScalarOrSchedule = 1e-4,
+                              decay: float = 0.9, momentum: float = 0.0,
+                              epsilon: float = 1e-10):
+  return optax.rmsprop(learning_rate, decay=decay, momentum=momentum,
+                       eps=epsilon)
+
+
+def maybe_clip_gradients(optimizer, clip_norm: Optional[float] = None):
+  """Global-norm clipping chained ahead of the optimizer update."""
+  if clip_norm is None:
+    return optimizer
+  return optax.chain(optax.clip_by_global_norm(clip_norm), optimizer)
+
+
+def create_ema(decay: float = 0.9999, debias: bool = True):
+  """Parameter averaging; the JAX form of MovingAverageOptimizer (ref :141)."""
+  return optax.ema(decay=decay, debias=debias)

@@ -1,0 +1,33 @@
+"""RegressionModel: continuous-output base (MSE loss).
+
+Parity target: /root/reference/models/regression_model.py:50-172. Subclasses
+declare specs and a network producing ``outputs['inference_output']``; labels
+carry the regression target under ``self.label_key``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class RegressionModel(AbstractT2RModel):
+
+  label_key = 'target'
+  output_key = 'inference_output'
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    predictions = inference_outputs[self.output_key]
+    targets = jnp.asarray(labels[self.label_key],
+                          predictions.dtype).reshape(predictions.shape)
+    loss = jnp.mean((predictions - targets).astype(jnp.float32) ** 2)
+    return loss, SpecStruct()
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, _ = self.model_train_fn(variables, features, labels,
+                                  inference_outputs, mode)
+    return SpecStruct(loss=loss, mean_squared_error=loss)

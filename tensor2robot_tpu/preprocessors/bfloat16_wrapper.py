@@ -82,20 +82,5 @@ class Bfloat16PreprocessorWrapper(AbstractPreprocessor):
       out[key] = value
     return out
 
-  def preprocess(self, features, labels, mode, rng=None):
-    # Validate against the wrapped f32 in-specs, then transform + cast.
-    features = specs_lib.validate_and_pack(
-        self.get_in_feature_specification(mode), features, ignore_batch=True)
-    if labels is not None and len(specs_lib.flatten_spec_structure(
-        self.get_in_label_specification(mode))):
-      labels = specs_lib.validate_and_pack(
-          self.get_in_label_specification(mode), labels, ignore_batch=True)
-    else:
-      labels = None
-    features, labels = self._preprocess_fn(features, labels, mode, rng)
-    features = specs_lib.validate_and_pack(
-        self.get_out_feature_specification(mode), features, ignore_batch=True)
-    if labels is not None:
-      labels = specs_lib.validate_and_pack(
-          self.get_out_label_specification(mode), labels, ignore_batch=True)
-    return features, labels
+  # preprocess() is inherited: the base validate -> _preprocess_fn ->
+  # validate template already runs against this wrapper's re-typed specs.

@@ -21,6 +21,14 @@ import jax.numpy as jnp
 def _check_shapes(images: Sequence[jnp.ndarray]) -> None:
   if not images:
     raise ValueError('Need at least one image batch.')
+  first = tuple(images[0].shape[:3])
+  for img in images[1:]:
+    if tuple(img.shape[:3]) != first:
+      # Shared offsets only align views of equal spatial size; mismatched
+      # views would silently crop different locations (dynamic_slice clamps).
+      raise ValueError(
+          'All views must share [B, H, W] for aligned crops; got {} vs {}.'
+          .format(first, tuple(img.shape[:3])))
 
 
 def crop_images(images: List[jnp.ndarray], offsets,

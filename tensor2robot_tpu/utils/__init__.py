@@ -1,0 +1,1 @@
+"""Utilities: mocks, fixtures, subsampling, schedules, CEM, image helpers."""

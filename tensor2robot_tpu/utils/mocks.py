@@ -1,0 +1,74 @@
+"""Mock model + input generator: the backbone of the test strategy.
+
+Parity target: /root/reference/utils/mocks.py (MockT2RModel :104 — a 3-layer
+MLP with batch norm over an 8-dim state; MockInputGenerator :48 — a
+deterministic linearly separable dataset, seed=1234).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.data.input_generators import GeneratorInputGenerator
+from tensor2robot_tpu.models.classification_model import ClassificationModel
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+MOCK_STATE_DIM = 8
+
+
+class _MockNetwork(nn.Module):
+  """3-layer MLP with batch norm (ref mocks.py:104)."""
+
+  use_batch_norm: bool = True
+
+  @nn.compact
+  def __call__(self, features, mode: str = 'train', train: bool = False):
+    x = jnp.asarray(features['measured_position'], jnp.float32)
+    for width in (100, 100):
+      x = nn.Dense(width)(x)
+      if self.use_batch_norm:
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+      x = nn.relu(x)
+    logits = nn.Dense(1)(x)
+    return {'logits': logits}
+
+
+class MockT2RModel(ClassificationModel):
+  """Tiny classification model over an 8-dim state vector."""
+
+  def __init__(self, use_batch_norm: bool = True, **kwargs):
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(**kwargs)
+    self._use_batch_norm = use_batch_norm
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return SpecStruct(measured_position=TensorSpec(
+        (MOCK_STATE_DIM,), np.float32, name='measured_position'))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return SpecStruct(target=TensorSpec((1,), np.float32, name='valid_position'))
+
+  def create_network(self) -> nn.Module:
+    return _MockNetwork(use_batch_norm=self._use_batch_norm)
+
+
+class MockInputGenerator(GeneratorInputGenerator):
+  """Deterministic linearly separable batches (ref mocks.py:48)."""
+
+  def __init__(self, seed: int = 1234, **kwargs):
+    super().__init__(**kwargs)
+    self._rng = np.random.RandomState(seed)
+
+  def _generate_batch(self, seed: Optional[int]):
+    states = self._rng.rand(self._batch_size, MOCK_STATE_DIM).astype(
+        np.float32)
+    # Linearly separable rule: positive iff mean(state) > 0.5.
+    labels = (states.mean(axis=1, keepdims=True) > 0.5).astype(np.float32)
+    features = SpecStruct(measured_position=states)
+    label_struct = SpecStruct(target=labels)
+    return features, label_struct

@@ -1,0 +1,203 @@
+"""Model abstraction tests: TrainState, train/eval/predict steps, EMA, critic."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.models import (
+    AbstractT2RModel,
+    CriticModel,
+    TrainState,
+    optimizers,
+)
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+def _init_state(model, batch_size=8):
+  gen = MockInputGenerator(batch_size=batch_size)
+  gen.set_specification_from_model(model, ModeKeys.TRAIN)
+  features, labels = next(gen.create_dataset_iterator(ModeKeys.TRAIN,
+                                                      num_epochs=1))
+  state = model.create_train_state(jax.random.PRNGKey(0), features, labels)
+  return state, features, labels, gen
+
+
+class TestMockModelTraining:
+
+  def test_loss_decreases_under_jit(self):
+    model = MockT2RModel()
+    state, features, labels, gen = _init_state(model)
+    train_step = jax.jit(model.train_step)
+    losses = []
+    it = gen.create_dataset_iterator(ModeKeys.TRAIN, num_epochs=50)
+    for i, (f, l) in enumerate(it):
+      state, metrics = train_step(state, f, l, jax.random.PRNGKey(i))
+      losses.append(float(metrics['loss']))
+    assert int(state.step) == 50
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+  def test_batch_stats_update_in_train_only(self):
+    model = MockT2RModel()
+    state, features, labels, _ = _init_state(model)
+    before = jax.tree.leaves(state.model_state['batch_stats'])
+    new_state, _ = jax.jit(model.train_step)(
+        state, features, labels, jax.random.PRNGKey(0))
+    after = jax.tree.leaves(new_state.model_state['batch_stats'])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    # Eval must not mutate anything.
+    metrics = jax.jit(model.eval_step)(new_state, features, labels)
+    assert set(metrics.keys()) >= {'loss', 'accuracy', 'precision', 'recall'}
+
+  def test_eval_metrics_sensible_after_training(self):
+    model = MockT2RModel()
+    state, _, _, gen = _init_state(model, batch_size=32)
+    train_step = jax.jit(model.train_step)
+    for i, (f, l) in enumerate(gen.create_dataset_iterator(
+        ModeKeys.TRAIN, num_epochs=200)):
+      state, _ = train_step(state, f, l, jax.random.PRNGKey(i))
+    f, l = next(gen.create_dataset_iterator(ModeKeys.EVAL, num_epochs=1))
+    metrics = jax.jit(model.eval_step)(state, f, l)
+    assert float(metrics['accuracy']) > 0.9
+
+  def test_predict_step_outputs(self):
+    model = MockT2RModel()
+    state, features, _, _ = _init_state(model)
+    out = jax.jit(model.predict_step)(state, features)
+    assert 'logits' in out and 'probabilities' in out
+    probs = np.asarray(out['probabilities'])
+    assert probs.min() >= 0 and probs.max() <= 1
+
+  def test_train_predict_parity(self):
+    """Same params -> inference path and predict path agree (the jit analog
+    of the reference's serving-vs-estimator parity test, train_eval_test:91)."""
+    model = MockT2RModel()
+    state, features, labels, _ = _init_state(model)
+    out_predict = jax.jit(model.predict_step)(state, features)
+    variables = state.variables()
+    out_infer, _ = model.inference_network_fn(
+        variables, features, labels, ModeKeys.PREDICT, None)
+    np.testing.assert_allclose(np.asarray(out_predict['logits']),
+                               np.asarray(out_infer['logits']), rtol=1e-5)
+
+
+class TestEMA:
+
+  def test_avg_params_track_and_serve(self):
+    model = MockT2RModel(use_avg_model_params=True,
+                         avg_model_params_decay=0.5)
+    state, features, labels, gen = _init_state(model)
+    assert state.avg_params is not None
+    train_step = jax.jit(model.train_step)
+    for i, (f, l) in enumerate(gen.create_dataset_iterator(
+        ModeKeys.TRAIN, num_epochs=5)):
+      state, _ = train_step(state, f, l, jax.random.PRNGKey(i))
+    raw = jax.tree.leaves(state.params)
+    avg = jax.tree.leaves(state.avg_params)
+    assert any(not np.allclose(r, a) for r, a in zip(raw, avg))
+    # predict uses averaged params: recompute manually to confirm.
+    out_avg = model.predict_step(state, features)
+    variables_avg = {'params': state.avg_params, **state.model_state}
+    expect, _ = model.inference_network_fn(variables_avg, features, None,
+                                           ModeKeys.PREDICT, None)
+    np.testing.assert_allclose(np.asarray(out_avg['logits']),
+                               np.asarray(expect['logits']), rtol=1e-5)
+
+
+class TestOptimizers:
+
+  def test_factories_produce_updates(self):
+    params = {'w': jnp.ones((3,))}
+    grads = {'w': jnp.ones((3,))}
+    for factory in (optimizers.create_adam_optimizer,
+                    optimizers.create_sgd_optimizer,
+                    optimizers.create_momentum_optimizer,
+                    optimizers.create_rms_prop_optimizer):
+      opt = factory(learning_rate=0.1)
+      opt_state = opt.init(params)
+      updates, _ = opt.update(grads, opt_state, params)
+      assert float(jnp.abs(updates['w']).sum()) > 0
+
+  def test_exponential_decay_schedule(self):
+    sched = optimizers.create_exponential_decay_learning_rate(
+        initial_learning_rate=1.0, decay_steps=10, decay_rate=0.5)
+    assert float(sched(0)) == 1.0
+    assert abs(float(sched(10)) - 0.5) < 1e-6
+
+  def test_gradient_clipping(self):
+    # SGD: post-clip update magnitude is lr * clipped-grad (adam would
+    # renormalize and defeat the assertion).
+    model = MockT2RModel(
+        gradient_clip_norm=1e-9,
+        create_optimizer_fn=lambda: optimizers.create_sgd_optimizer(0.1))
+    state, features, labels, _ = _init_state(model)
+    new_state, _ = jax.jit(model.train_step)(
+        state, features, labels, jax.random.PRNGKey(0))
+    deltas = jax.tree.map(lambda a, b: float(np.abs(a - b).max()),
+                          state.params, new_state.params)
+    assert max(jax.tree.leaves(deltas)) < 1e-6
+
+
+class _TinyQNet(nn.Module):
+  @nn.compact
+  def __call__(self, features, mode='train', train=False):
+    x = jnp.concatenate([
+        jnp.asarray(features['state/obs'], jnp.float32),
+        jnp.asarray(features['action/command'], jnp.float32)], axis=-1)
+    x = nn.relu(nn.Dense(16)(x))
+    logits = nn.Dense(1)(x)
+    return {'q_logits': logits, 'q_predicted': nn.sigmoid(logits)}
+
+
+class _TinyCritic(CriticModel):
+
+  def __init__(self, **kwargs):
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(**kwargs)
+
+  def get_state_specification(self):
+    return SpecStruct(obs=TensorSpec((4,), np.float32, name='obs'))
+
+  def get_action_specification(self):
+    return SpecStruct(command=TensorSpec((2,), np.float32, name='command'))
+
+  def get_label_specification(self, mode):
+    return SpecStruct(reward=TensorSpec((1,), np.float32, name='reward'))
+
+  def create_network(self):
+    return _TinyQNet()
+
+
+class TestCriticModel:
+
+  def test_merged_feature_spec(self):
+    critic = _TinyCritic()
+    spec = critic.get_feature_specification(ModeKeys.TRAIN)
+    assert 'state/obs' in spec and 'action/command' in spec
+
+  def test_train_and_predict_with_action_tiling(self):
+    critic = _TinyCritic(action_batch_size=16)
+    features = SpecStruct()
+    features['state/obs'] = jnp.ones((1, 4), jnp.float32)
+    features['action/command'] = jnp.zeros((16, 2), jnp.float32)
+    labels = SpecStruct(reward=jnp.ones((16, 1), jnp.float32))
+    train_features = SpecStruct()
+    train_features['state/obs'] = jnp.ones((16, 4), jnp.float32)
+    train_features['action/command'] = jnp.zeros((16, 2), jnp.float32)
+    state = critic.create_train_state(jax.random.PRNGKey(0), train_features,
+                                      labels)
+    new_state, metrics = jax.jit(critic.train_step)(
+        state, train_features, labels, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics['loss']))
+    # Predict: single state tiled over the action batch.
+    out = jax.jit(critic.predict_step)(state, features)
+    assert out['q_predicted'].shape == (16, 1)
+
+  def test_logit_fallback_from_q(self):
+    critic = _TinyCritic()
+    outputs = SpecStruct(q_predicted=jnp.asarray([[0.5]]))
+    logits = critic.logit_of(outputs)
+    assert abs(float(logits[0, 0])) < 1e-5
