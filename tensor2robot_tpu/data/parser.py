@@ -177,8 +177,16 @@ class ExampleParser:
                 'available: {}.'.format(name, sorted(feature_lists)))
           steps = [self._decode_value(spec, step, is_step=True)
                    for step in feature_lists[name]]
-          arr = (np.stack(steps) if steps else
-                 np.zeros((0,) + tuple(s or 1 for s in spec.shape), spec.dtype))
+          if steps and isinstance(steps[0], bytes):
+            # Raw encoded frames: keep dtype=object (np.stack would coerce
+            # to fixed-width 'S', NUL-padding/stripping the payloads).
+            arr = np.empty(len(steps), dtype=object)
+            arr[:] = steps
+          elif steps:
+            arr = np.stack(steps)
+          else:
+            arr = np.zeros((0,) + tuple(s or 1 for s in spec.shape),
+                           spec.dtype)
           out[name] = arr
           out[name + '_length'] = np.asarray(len(steps), dtype=np.int64)
         else:
@@ -260,6 +268,10 @@ class ExampleParser:
         if flat[key].is_encoded_image:
           if key in tensors:
             passthrough[key] = tensors[key]
+          elif not flat[key].is_optional:
+            raise ValueError(
+                'Required encoded-image tensor {!r} missing; available: {}.'
+                .format(key, sorted(tensors.keys())))
         else:
           checked[key] = flat[key]
       out = specs_lib.validate_and_pack(checked, tensors, ignore_batch=True)

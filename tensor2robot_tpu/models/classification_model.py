@@ -8,6 +8,7 @@ the reference's mse/accuracy/precision/recall set (:203-242).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -33,9 +34,7 @@ class ClassificationModel(AbstractT2RModel):
     logits = inference_outputs[self.logits_key]
     targets = jnp.asarray(labels[self.label_key], logits.dtype).reshape(
         logits.shape)
-    probabilities = jnp.asarray(
-        jnp.reshape(jnp.float32(1) / (1 + jnp.exp(-logits.astype(jnp.float32))),
-                    logits.shape))
+    probabilities = jax.nn.sigmoid(logits.astype(jnp.float32))
     predictions = (probabilities > 0.5).astype(jnp.float32)
     targets_f = targets.astype(jnp.float32)
     true_positives = jnp.sum(predictions * targets_f)
@@ -56,5 +55,5 @@ class ClassificationModel(AbstractT2RModel):
     logits = inference_outputs[self.logits_key]
     out = SpecStruct()
     out[self.logits_key] = logits
-    out['probabilities'] = 1.0 / (1.0 + jnp.exp(-logits.astype(jnp.float32)))
+    out['probabilities'] = jax.nn.sigmoid(logits.astype(jnp.float32))
     return out

@@ -80,8 +80,9 @@ class CriticModel(AbstractT2RModel):
   def tile_state_for_action_batch(self, features: SpecStruct) -> SpecStruct:
     """Expands state [B, ...] to [B*action_batch_size, ...] (ref :128-141).
 
-    The predictor feeds one state and ``action_batch_size`` candidate
-    actions; the network then scores them in one batched forward.
+    The predictor feeds B states and B*action_batch_size candidate actions
+    grouped per state; ``repeat`` keeps state i aligned with its contiguous
+    block of actions, and the network scores them in one batched forward.
     """
     if self._action_batch_size is None:
       return features
@@ -89,14 +90,10 @@ class CriticModel(AbstractT2RModel):
     for key in algebra.flatten_spec_structure(features):
       value = features[key]
       if key.startswith('state/'):
-        reps = (self._action_batch_size,) + (1,) * (value.ndim - 1)
-        value = jnp.tile(value, reps)
+        value = jnp.repeat(value, self._action_batch_size, axis=0)
       tiled[key] = value
     return tiled
 
   def predict_step(self, state, features) -> SpecStruct:
-    features = self.tile_state_for_action_batch(features)
-    variables = state.variables(use_avg_params=self.use_avg_model_params)
-    outputs, _ = self.inference_network_fn(variables, features, None,
-                                           ModeKeys.PREDICT, None)
-    return self.create_export_outputs_fn(features, outputs, ModeKeys.PREDICT)
+    return super().predict_step(state,
+                                self.tile_state_for_action_batch(features))

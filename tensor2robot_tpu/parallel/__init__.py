@@ -1,0 +1,22 @@
+"""Parallelism: mesh construction, sharding rules, collectives, ring attention."""
+
+from tensor2robot_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    create_hybrid_mesh,
+    create_mesh,
+)
+from tensor2robot_tpu.parallel.sharding import (
+    batch_sharding,
+    fsdp_param_spec,
+    global_batch_size_per_host,
+    replicated,
+    shard_batch,
+    train_state_sharding,
+)
+from tensor2robot_tpu.parallel import collectives
+from tensor2robot_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_self_attention,
+)

@@ -1,0 +1,65 @@
+"""Collective helpers over the mesh (the framework's communication backend).
+
+The reference has no in-repo communication layer — TF1 gRPC/TPU all-reduce
+did it invisibly (SURVEY.md §2.9/§5). Here the backend is explicit and tiny:
+XLA collectives over mesh axes, riding ICI within a slice and DCN across
+slices. These wrappers exist so higher layers (trainer, meta-learning, ring
+attention) never hand-roll shard_map plumbing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = Union[str, Sequence[str]]
+
+
+def pmean(value, axis_name: AxisName):
+  return lax.pmean(value, axis_name)
+
+
+def psum(value, axis_name: AxisName):
+  return lax.psum(value, axis_name)
+
+
+def all_gather(value, axis_name: AxisName, axis: int = 0,
+               tiled: bool = True):
+  return lax.all_gather(value, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(value, axis_name: AxisName, axis: int = 0):
+  return lax.psum_scatter(value, axis_name, scatter_dimension=axis,
+                          tiled=True)
+
+
+def ring_permute(value, axis_name: str, shift: int = 1):
+  """Sends ``value`` to the next device along a ring (ppermute over ICI)."""
+  n = lax.psum(1, axis_name)
+  perm = [(i, (i + shift) % n) for i in range(n)]
+  return lax.ppermute(value, axis_name, perm)
+
+
+def cross_replica_mean(tree, axis_name: AxisName = 'data'):
+  """Mean of every leaf across the axis — e.g. batch-stat sync.
+
+  The explicit form of what pjit inserts for gradients automatically.
+  """
+  return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def sharded_fn(mesh: Mesh, in_specs, out_specs,
+               check_vma: bool = False) -> Callable:
+  """Decorator: run a function per-shard with explicit collectives.
+
+  Thin veneer over ``jax.shard_map`` so call sites read declaratively.
+  """
+  def decorator(fn):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=check_vma)
+  return decorator

@@ -65,8 +65,10 @@ class MockInputGenerator(GeneratorInputGenerator):
     self._rng = np.random.RandomState(seed)
 
   def _generate_batch(self, seed: Optional[int]):
-    states = self._rng.rand(self._batch_size, MOCK_STATE_DIM).astype(
-        np.float32)
+    # Honor the per-batch seed contract for reproducible replay; fall back
+    # to the stateful stream when unseeded.
+    rng = self._rng if seed is None else np.random.RandomState(seed)
+    states = rng.rand(self._batch_size, MOCK_STATE_DIM).astype(np.float32)
     # Linearly separable rule: positive iff mean(state) > 0.5.
     labels = (states.mean(axis=1, keepdims=True) > 0.5).astype(np.float32)
     features = SpecStruct(measured_position=states)
