@@ -1,0 +1,65 @@
+"""Benchmark: training throughput on the flagship model, one JSON line.
+
+The BASELINE.md north star is grasp-samples/sec/chip on the QT-Opt critic;
+until that model lands this measures the mock-model train step through the
+full harness (same code path: sharded batch, donated state, jitted step).
+"""
+
+import json
+import time
+
+
+def main():
+  import jax
+
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.parallel import sharding as sharding_lib
+  from tensor2robot_tpu import parallel
+  from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+  batch_size = 512
+  model = MockT2RModel(use_batch_norm=True, device_type='tpu'
+                       if jax.default_backend() != 'cpu' else 'cpu')
+  generator = MockInputGenerator(batch_size=batch_size)
+  generator.set_specification_from_model(model, ModeKeys.TRAIN)
+  iterator = generator.create_dataset_iterator(mode=ModeKeys.TRAIN)
+  features, labels = next(iterator)
+
+  mesh = parallel.create_mesh()
+  state = None
+  import tempfile
+  from tensor2robot_tpu.trainer import Trainer
+  with tempfile.TemporaryDirectory() as tmp:
+    trainer = Trainer(model, tmp, mesh=mesh, async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=10**9)
+    state = trainer.init_state(features, labels)
+    step_fn = trainer._compile_train_step()
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rng = jax.device_put(jax.random.PRNGKey(1), NamedSharding(mesh, P()))
+    batch = sharding_lib.shard_batch(
+        {'features': features.to_dict(), 'labels': labels.to_dict()}, mesh)
+    # Warmup/compile.
+    state, _ = step_fn(state, batch['features'], batch['labels'], rng)
+    jax.block_until_ready(state.params)
+    n_steps = 200
+    t0 = time.time()
+    for _ in range(n_steps):
+      state, metrics = step_fn(state, batch['features'], batch['labels'], rng)
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+    trainer.close()
+
+  examples_per_sec = batch_size * n_steps / dt
+  per_chip = examples_per_sec / jax.device_count()
+  baseline = 4000.0  # BASELINE.md: QT-Opt target samples/sec/chip
+  print(json.dumps({
+      'metric': 'train_examples_per_sec_per_chip',
+      'value': round(per_chip, 2),
+      'unit': 'examples/sec/chip',
+      'vs_baseline': round(per_chip / baseline, 4),
+  }))
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,11 @@
+"""NN layer library: vision towers, FiLM-ResNet, MDN, SNAIL, TEC."""
+
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.layers import resnet
+from tensor2robot_tpu.layers import snail
+from tensor2robot_tpu.layers import tec
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+
+__all__ = ['mdn', 'resnet', 'snail', 'spatial_softmax', 'tec',
+           'vision_layers']

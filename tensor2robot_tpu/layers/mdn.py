@@ -1,0 +1,148 @@
+"""Mixture density networks, pure-functional.
+
+Parity target: /root/reference/layers/mdn.py (get_mixture_distribution :34,
+predict_mdn_params :77, gaussian_mixture_approximate_mode :118,
+MDNDecoder :129). The tfp MixtureSameFamily distribution object becomes a
+small frozen parameter dataclass + pure log-prob/mode/sample functions —
+the decoder stays stateless so MAML-style wrappers can call it repeatedly
+(the reference's TODO about stateful decoders disappears by construction).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MixtureParams(NamedTuple):
+  """Diagonal Gaussian mixture parameters.
+
+  alphas: [..., K] mixture logits.
+  mus: [..., K, D] component means.
+  sigmas: [..., K, D] component scales (positive).
+  """
+  alphas: jnp.ndarray
+  mus: jnp.ndarray
+  sigmas: jnp.ndarray
+
+
+def get_mixture_distribution(params: jnp.ndarray,
+                             num_alphas: int,
+                             sample_size: int,
+                             output_mean: Optional[jnp.ndarray] = None
+                             ) -> MixtureParams:
+  """Splits a flat param tensor into mixture parameters (ref mdn.py:34).
+
+  Args:
+    params: [..., num_alphas + 2*num_alphas*sample_size].
+    num_alphas: number of mixture components K.
+    sample_size: event size D.
+    output_mean: optional translation added to every component mean.
+  """
+  num_mus = num_alphas * sample_size
+  if params.shape[-1] != num_alphas + 2 * num_mus:
+    raise ValueError(
+        'Params has unexpected trailing dim {} (want {}).'.format(
+            params.shape[-1], num_alphas + 2 * num_mus))
+  alphas = params[..., :num_alphas]
+  batch_shape = params.shape[:-1]
+  mus = params[..., num_alphas:num_alphas + num_mus].reshape(
+      batch_shape + (num_alphas, sample_size))
+  raw_sigmas = params[..., num_alphas + num_mus:].reshape(
+      batch_shape + (num_alphas, sample_size))
+  if output_mean is not None:
+    mus = mus + output_mean
+  return MixtureParams(alphas=alphas, mus=mus,
+                       sigmas=jax.nn.softplus(raw_sigmas))
+
+
+def mixture_log_prob(gm: MixtureParams, value: jnp.ndarray) -> jnp.ndarray:
+  """log p(value) under the mixture; value: [..., D] -> [...]."""
+  log_alphas = jax.nn.log_softmax(gm.alphas, axis=-1)          # [..., K]
+  diff = (value[..., None, :] - gm.mus) / gm.sigmas            # [..., K, D]
+  log_det = jnp.sum(jnp.log(gm.sigmas), axis=-1)               # [..., K]
+  d = gm.mus.shape[-1]
+  component_lp = (-0.5 * jnp.sum(diff * diff, axis=-1)
+                  - log_det - 0.5 * d * np.log(2.0 * np.pi))
+  return jax.nn.logsumexp(log_alphas + component_lp, axis=-1)
+
+
+def gaussian_mixture_approximate_mode(gm: MixtureParams) -> jnp.ndarray:
+  """Mean of the most probable component (ref mdn.py:118)."""
+  mode_alpha = jnp.argmax(gm.alphas, axis=-1)                  # [...]
+  return jnp.take_along_axis(
+      gm.mus, mode_alpha[..., None, None], axis=-2).squeeze(-2)
+
+
+def mixture_sample(gm: MixtureParams, rng: jax.Array) -> jnp.ndarray:
+  """Draws one sample: component via categorical, then diagonal normal."""
+  k_rng, n_rng = jax.random.split(rng)
+  component = jax.random.categorical(k_rng, gm.alphas, axis=-1)
+  mu = jnp.take_along_axis(
+      gm.mus, component[..., None, None], axis=-2).squeeze(-2)
+  sigma = jnp.take_along_axis(
+      gm.sigmas, component[..., None, None], axis=-2).squeeze(-2)
+  return mu + sigma * jax.random.normal(n_rng, mu.shape, mu.dtype)
+
+
+class MDNParamsLayer(nn.Module):
+  """Linear head producing mixture params (ref predict_mdn_params :77).
+
+  With ``condition_sigmas=False`` the scales are free learned variables
+  initialized so softplus(sigma_raw) == 1, broadcast over the batch.
+  """
+
+  num_alphas: int
+  sample_size: int
+  condition_sigmas: bool = False
+
+  @nn.compact
+  def __call__(self, inputs: jnp.ndarray) -> jnp.ndarray:
+    num_mus = self.num_alphas * self.sample_size
+    num_outputs = self.num_alphas + num_mus
+    if self.condition_sigmas:
+      num_outputs += num_mus
+    dist_params = nn.Dense(num_outputs, name='mdn_params')(inputs)
+    if not self.condition_sigmas:
+      sigmas = self.param(
+          'mdn_stddev_inputs',
+          nn.initializers.constant(np.log(np.e - 1.0)), (num_mus,),
+          jnp.float32)
+      tiled = jnp.broadcast_to(
+          sigmas.astype(dist_params.dtype),
+          dist_params.shape[:-1] + (num_mus,))
+      dist_params = jnp.concatenate([dist_params, tiled], axis=-1)
+    return dist_params
+
+
+class MDNDecoder(nn.Module):
+  """Action decoder head (ref MDNDecoder :129), stateless.
+
+  __call__ returns (action, mixture_params); the loss is the separate pure
+  function :func:`mdn_loss` over (mixture_params, labels).
+  """
+
+  num_mixture_components: int = 1
+  output_size: int = 1
+  condition_sigmas: bool = False
+
+  @nn.compact
+  def __call__(self, params_input: jnp.ndarray):
+    dist_params = MDNParamsLayer(
+        num_alphas=self.num_mixture_components,
+        sample_size=self.output_size,
+        condition_sigmas=self.condition_sigmas)(params_input)
+    gm = get_mixture_distribution(
+        dist_params.astype(jnp.float32), self.num_mixture_components,
+        self.output_size)
+    action = gaussian_mixture_approximate_mode(gm)
+    return action, gm
+
+
+def mdn_loss(gm: MixtureParams, target: jnp.ndarray) -> jnp.ndarray:
+  """Mean negative log-likelihood across batch/sequence dims."""
+  return -jnp.mean(mixture_log_prob(gm, target))

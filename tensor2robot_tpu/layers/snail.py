@@ -1,0 +1,94 @@
+"""SNAIL: attentive temporal meta-learner building blocks.
+
+Parity target: /root/reference/layers/snail.py (CausalConv :35, DenseBlock
+:60, TCBlock :78, CausallyMaskedSoftmax :95, AttentionBlock :119 — the
+architecture of arXiv:1707.03141). Causal padding + static unrolled
+dilation stack keeps everything shape-static for XLA; the attention mask
+is additive -inf on the strict upper triangle.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+class CausalConv(nn.Module):
+  """Causal dilated 1D convolution over [batch, time, channels]."""
+
+  filters: int
+  dilation_rate: int = 1
+  kernel_size: int = 2
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    causal_pad = (self.kernel_size - 1) * self.dilation_rate
+    x = jnp.pad(x, ((0, 0), (causal_pad, 0), (0, 0)))
+    return nn.Conv(
+        features=self.filters,
+        kernel_size=(self.kernel_size,),
+        kernel_dilation=(self.dilation_rate,),
+        padding='VALID')(x)
+
+
+class DenseBlock(nn.Module):
+  """Gated causal conv whose activations concatenate onto the input."""
+
+  filters: int
+  dilation_rate: int = 1
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    xf = CausalConv(self.filters, self.dilation_rate, name='xf')(x)
+    xg = CausalConv(self.filters, self.dilation_rate, name='xg')(x)
+    activations = jnp.tanh(xf) * nn.sigmoid(xg)
+    return jnp.concatenate([x, activations], axis=2)
+
+
+class TCBlock(nn.Module):
+  """Stack of DenseBlocks with exponentially increasing dilation.
+
+  Output channels = channels + filters * ceil(log2(sequence_length)).
+  """
+
+  sequence_length: int
+  filters: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    for i in range(1, int(np.ceil(np.log2(self.sequence_length))) + 1):
+      x = DenseBlock(self.filters, 2 ** i, name='DenseBlock_%d' % i)(x)
+    return x
+
+
+def causally_masked_softmax(logits: jnp.ndarray) -> jnp.ndarray:
+  """Row-wise softmax over [..., T, T] with j > i masked out."""
+  t = logits.shape[-1]
+  mask = jnp.tril(jnp.ones((t, t), bool))
+  masked = jnp.where(mask, logits, -jnp.inf)
+  probs = nn.softmax(masked, axis=-1)
+  # Exact zeros above the diagonal (softmax of -inf already is, but keep
+  # the reference's explicit band_part semantics for bit-stability).
+  return jnp.where(mask, probs, 0.0)
+
+
+class AttentionBlock(nn.Module):
+  """Causal single-head KV attention; read concatenates onto the input."""
+
+  key_size: int
+  value_size: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, dict]:
+    key = nn.Dense(self.key_size)(x)
+    query = nn.Dense(self.key_size)(x)
+    logits = jnp.einsum('btk,bsk->bts', query, key)
+    probs = causally_masked_softmax(
+        logits / np.sqrt(self.key_size))
+    values = nn.Dense(self.value_size)(x)
+    read = jnp.einsum('bts,bsv->btv', probs, values)
+    result = jnp.concatenate([x, read], axis=2)
+    return result, {'attn_prob': probs}

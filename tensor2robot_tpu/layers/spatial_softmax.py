@@ -1,0 +1,60 @@
+"""Spatial-softmax keypoint extraction.
+
+Parity target: /root/reference/layers/spatial_softmax.py:34
+(BuildSpatialSoftmax + gumbel variant). The computation is one fused
+softmax + two weighted reductions — XLA fuses the position-grid multiplies
+into the softmax's normalization pass, so activations stream through VMEM
+once; no Pallas needed at these map sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _position_grids(num_rows: int, num_cols: int, dtype):
+  """x/y coordinate grids in [-1, 1], matching the reference layout."""
+  cols = jnp.linspace(-1.0, 1.0, num_cols, dtype=dtype)
+  rows = jnp.linspace(-1.0, 1.0, num_rows, dtype=dtype)
+  x_pos = jnp.tile(cols[None, :], (num_rows, 1)).reshape(-1)
+  y_pos = jnp.tile(rows[:, None], (1, num_cols)).reshape(-1)
+  return x_pos, y_pos
+
+
+def spatial_softmax(features: jnp.ndarray,
+                    temperature: float = 1.0,
+                    gumbel_rng: Optional[jax.Array] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Expected 2D feature locations via softmax attention over the map.
+
+  Args:
+    features: [batch, num_rows, num_cols, channels].
+    temperature: softmax temperature.
+    gumbel_rng: if set, samples locations stochastically by perturbing the
+      logits with Gumbel noise (the RelaxedOneHotCategorical sample of the
+      reference, temperature fixed at 1.0 there).
+
+  Returns:
+    (expected_points [batch, 2*channels] laid out [x1..xC, y1..yC],
+     softmax maps [batch, num_rows, num_cols, channels]).
+  """
+  batch, num_rows, num_cols, channels = features.shape
+  dtype = features.dtype
+  x_pos, y_pos = _position_grids(num_rows, num_cols, dtype)
+  # [B, H, W, C] -> [B, C, H*W]: one batched softmax over locations.
+  logits = jnp.transpose(features, (0, 3, 1, 2)).reshape(
+      batch, channels, num_rows * num_cols)
+  logits = logits / jnp.asarray(temperature, dtype)
+  if gumbel_rng is not None:
+    gumbel = jax.random.gumbel(gumbel_rng, logits.shape, dtype)
+    logits = logits + gumbel
+  attention = jax.nn.softmax(logits, axis=-1)
+  expected_x = jnp.sum(attention * x_pos, axis=-1)   # [B, C]
+  expected_y = jnp.sum(attention * y_pos, axis=-1)   # [B, C]
+  expected_points = jnp.concatenate([expected_x, expected_y], axis=-1)
+  softmax_maps = jnp.transpose(
+      attention.reshape(batch, channels, num_rows, num_cols), (0, 2, 3, 1))
+  return expected_points, softmax_maps

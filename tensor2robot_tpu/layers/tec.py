@@ -1,0 +1,125 @@
+"""Task-embedded control (TEC) embedding layers + contrastive loss.
+
+Parity target: /root/reference/layers/tec.py (embed_fullstate :30,
+embed_condition_images :54, reduce_temporal_embeddings :91,
+compute_embedding_contrastive_loss :136).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.vision_layers import ImagesToFeaturesNet
+
+
+class EmbedFullstate(nn.Module):
+  """MLP embedding of non-image state vectors [N, F] -> [N, embed]."""
+
+  embed_size: int
+  fc_layers: Sequence[int] = (100,)
+
+  @nn.compact
+  def __call__(self, fullstate: jnp.ndarray) -> jnp.ndarray:
+    x = fullstate
+    for width in self.fc_layers:
+      x = nn.Dense(width)(x)
+      x = nn.LayerNorm()(x)
+      x = nn.relu(x)
+    return nn.Dense(self.embed_size)(x)
+
+
+class EmbedConditionImages(nn.Module):
+  """Image embedding via the keypoint tower + optional MLP head."""
+
+  fc_layers: Optional[Sequence[int]] = None
+
+  @nn.compact
+  def __call__(self, condition_image: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    if condition_image.ndim != 4:
+      raise ValueError(
+          'Image has unexpected shape {}.'.format(condition_image.shape))
+    embedding, _ = ImagesToFeaturesNet()(condition_image, train=train)
+    if self.fc_layers is not None:
+      for width in self.fc_layers[:-1]:
+        embedding = nn.Dense(width)(embedding)
+        embedding = nn.LayerNorm()(embedding)
+        embedding = nn.relu(embedding)
+      embedding = nn.Dense(self.fc_layers[-1])(embedding)
+    return embedding
+
+
+class ReduceTemporalEmbeddings(nn.Module):
+  """[N, T, F] episode embedding -> [N, output_size] via 1D convs + MLP."""
+
+  output_size: int
+  conv1d_layers: Optional[Sequence[int]] = (64,)
+  fc_hidden_layers: Sequence[int] = (100,)
+  kernel_size: int = 10
+
+  @nn.compact
+  def __call__(self, temporal_embedding: jnp.ndarray) -> jnp.ndarray:
+    if temporal_embedding.ndim != 3:
+      raise ValueError('Temporal embedding has unexpected shape {}.'.format(
+          temporal_embedding.shape))
+    x = temporal_embedding
+    if self.conv1d_layers is not None:
+      for num_filters in self.conv1d_layers:
+        x = nn.Conv(num_filters, (self.kernel_size,), padding='VALID',
+                    use_bias=False)(x)
+        x = nn.relu(x)
+        x = nn.LayerNorm()(x)
+    x = x.reshape((x.shape[0], -1))
+    for width in self.fc_hidden_layers:
+      x = nn.Dense(width)(x)
+      x = nn.LayerNorm()(x)
+      x = nn.relu(x)
+    return nn.Dense(self.output_size)(x)
+
+
+def contrastive_loss(labels: jnp.ndarray,
+                     embeddings_anchor: jnp.ndarray,
+                     embeddings_positive: jnp.ndarray,
+                     margin: float = 1.0) -> jnp.ndarray:
+  """Classic Hadsell et al. contrastive loss on embedding pairs.
+
+  labels: [N] bool/int, 1 when the pair is a genuine match. Matches the
+  tf_slim metric_learning.contrastive_loss semantics the reference calls.
+  """
+  distances = jnp.sqrt(
+      jnp.sum((embeddings_anchor - embeddings_positive) ** 2, axis=-1)
+      + 1e-12)
+  labels_f = labels.astype(distances.dtype)
+  match_loss = labels_f * distances ** 2
+  mismatch_loss = (1.0 - labels_f) * jnp.maximum(margin - distances, 0.0) ** 2
+  return jnp.mean(match_loss + mismatch_loss)
+
+
+def compute_embedding_contrastive_loss(
+    inf_embedding: jnp.ndarray,
+    con_embedding: jnp.ndarray,
+    positives: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+  """Anchor = task-0 inference embedding vs every task's condition embedding.
+
+  inf_embedding: [num_tasks, num_inf_episodes, K] (L2-normalized).
+  con_embedding: [num_tasks, num_con_episodes, K].
+  positives: optional [num_tasks] bool; default: only task 0 is positive.
+  """
+  if inf_embedding.ndim != 3:
+    raise ValueError(
+        'Unexpected inf_embedding shape: {}.'.format(inf_embedding.shape))
+  if con_embedding.ndim != 3:
+    raise ValueError(
+        'Unexpected con_embedding shape: {}.'.format(con_embedding.shape))
+  avg_inf = jnp.mean(inf_embedding, axis=1)
+  avg_con = jnp.mean(con_embedding, axis=1)
+  anchor = avg_inf[0:1]
+  if positives is not None:
+    labels = positives
+  else:
+    labels = jnp.arange(avg_con.shape[0]) == 0
+  anchor_tiled = jnp.broadcast_to(anchor, avg_con.shape)
+  return contrastive_loss(labels, anchor_tiled, avg_con)

@@ -1,0 +1,197 @@
+"""Vision towers: image -> keypoint features -> pose heads, with FiLM.
+
+Parity target: /root/reference/layers/vision_layers.py
+(BuildImagesToFeaturesModel :34, BuildFILMParams :155, HighRes
+multi-resolution variant :178, BuildImageFeaturesToPoseModel :270). slim
+arg_scopes become explicit Flax modules; FiLM is applied pre-activation as
+(1 + gamma) * h + beta; each conv follows the slim ordering
+conv -> normalizer -> (FiLM) -> ReLU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
+
+_CHANNELS_PER_BLOCK = 32
+
+
+def split_film_params(film_output_params: jnp.ndarray,
+                      num_blocks: int
+                      ) -> Tuple[Sequence[jnp.ndarray], Sequence[jnp.ndarray]]:
+  """[batch, 2*num_blocks*C] -> per-block broadcastable (1+gamma), beta."""
+  expected = 2 * num_blocks * _CHANNELS_PER_BLOCK
+  if film_output_params.ndim != 2 or film_output_params.shape[-1] != expected:
+    raise ValueError(
+        'FiLM params must be [batch, {}]; got {}.'.format(
+            expected, film_output_params.shape))
+  reshaped = film_output_params[:, None, None, :]
+  chunks = jnp.split(reshaped, 2 * num_blocks, axis=-1)
+  gammas = [1.0 + g for g in chunks[:num_blocks]]
+  betas = chunks[num_blocks:]
+  return gammas, betas
+
+
+class ImagesToFeaturesNet(nn.Module):
+  """Conv tower + spatial softmax -> expected keypoints (ref :34).
+
+  Returns (expected_feature_points [B, 2*num_output_maps],
+  {'softmax': maps}).
+  """
+
+  filter_size: int = 3
+  num_blocks: int = 5
+  num_output_maps: int = 32
+  use_batch_norm: bool = False   # reference defaults to layer norm
+  stride2_blocks: Sequence[int] = (0, 1)
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               film_output_params: Optional[jnp.ndarray] = None,
+               train: bool = False):
+    gammas = betas = None
+    if film_output_params is not None:
+      gammas, betas = split_film_params(film_output_params, self.num_blocks)
+    net = images
+    for i in range(self.num_blocks):
+      stride = 2 if i in self.stride2_blocks else 1
+      net = nn.Conv(
+          features=_CHANNELS_PER_BLOCK,
+          kernel_size=(self.filter_size, self.filter_size),
+          strides=(stride, stride),
+          padding='VALID',
+          bias_init=nn.initializers.constant(0.01),
+          kernel_init=nn.initializers.xavier_uniform(),
+          name='conv{:d}'.format(i + 2))(net)
+      net = self._normalize(net, train, scale=False,
+                            name='norm{:d}'.format(i + 2))
+      if gammas is not None:
+        net = gammas[i] * net + betas[i]
+      net = nn.relu(net)
+    net = nn.Conv(
+        features=self.num_output_maps, kernel_size=(1, 1), padding='VALID',
+        bias_init=nn.initializers.constant(0.01),
+        kernel_init=nn.initializers.xavier_uniform(),
+        name='final_conv_1x1')(net)
+    net = self._normalize(net, train, scale=True, name='final_norm')
+    net = nn.relu(net)
+    expected_points, softmax_maps = spatial_softmax(net)
+    return expected_points, {'softmax': softmax_maps}
+
+  def _normalize(self, net, train, scale, name):
+    if self.use_batch_norm:
+      return nn.BatchNorm(
+          use_running_average=not train, momentum=0.99, epsilon=1e-4,
+          use_scale=scale, name=name)(net)
+    return nn.LayerNorm(use_scale=scale, name=name)(net)
+
+
+class ImagesToFeaturesHighResNet(nn.Module):
+  """Multi-resolution feature-sum tower (ref :178, PI-GPS arch).
+
+  Per-block 1x1 taps are nearest-resized up to the first tap's resolution
+  and summed; the spatial softmax runs at that highest resolution.
+  """
+
+  filter_size: int = 3
+  num_blocks: int = 5
+  num_output_maps: int = 32
+  use_batch_norm: bool = True    # reference HighRes defaults to batch norm
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray, train: bool = False):
+    def conv(features, kernel, stride, name):
+      return nn.Conv(
+          features=features, kernel_size=(kernel, kernel),
+          strides=(stride, stride), padding='VALID',
+          kernel_init=nn.initializers.truncated_normal(stddev=0.1),
+          name=name)
+
+    def norm_relu(net, scale, name):
+      if self.use_batch_norm:
+        net = nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                           epsilon=1e-4, use_scale=scale, name=name)(net)
+      else:
+        net = nn.LayerNorm(use_scale=scale, name=name)(net)
+      return nn.relu(net)
+
+    block_outs = []
+    net = nn.avg_pool(images, (2, 2), strides=(2, 2), padding='VALID')
+    net = conv(16, self.filter_size, 2, 'conv1')(net)
+    net = norm_relu(net, False, 'norm1')
+    net = conv(32, self.filter_size, 1, 'conv2')(net)
+    net = norm_relu(net, False, 'norm2')
+    tap = conv(32, 1, 1, 'conv2_1x1')(net)
+    block_outs.append(norm_relu(tap, False, 'norm2_1x1'))
+    for i in range(1, self.num_blocks):
+      net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
+      net = conv(32, self.filter_size, 1, 'conv{:d}'.format(i + 2))(net)
+      net = norm_relu(net, False, 'norm{:d}'.format(i + 2))
+      tap = conv(32, 1, 1, 'conv{:d}_1x1'.format(i + 2))(net)
+      block_outs.append(norm_relu(tap, False, 'norm{:d}_1x1'.format(i + 2)))
+    target_hw = block_outs[0].shape[1:3]
+    resized = [
+        jax.image.resize(
+            layer, layer.shape[:1] + target_hw + layer.shape[3:],
+            method='nearest') for layer in block_outs
+    ]
+    net = sum(resized)
+    net = conv(self.num_output_maps, 1, 1, 'final_conv_1x1')(net)
+    net = norm_relu(net, True, 'final_norm')
+    expected_points, softmax_maps = spatial_softmax(net)
+    return expected_points, {'softmax': softmax_maps}
+
+
+class FilmParams(nn.Module):
+  """Linear FiLM generator (ref BuildFILMParams :155)."""
+
+  film_output_size: int = 2 * 5 * _CHANNELS_PER_BLOCK
+
+  @nn.compact
+  def __call__(self, embedding: jnp.ndarray) -> jnp.ndarray:
+    return nn.Dense(self.film_output_size, name='film')(embedding)
+
+
+class ImageFeaturesToPoseNet(nn.Module):
+  """Feature points (+ aux input) -> pose vector (ref :270).
+
+  With ``aux_output_dim > 0`` returns (pose, aux_prediction); with
+  ``num_outputs is None`` returns the last hidden layer.
+  """
+
+  num_outputs: Optional[int] = 7
+  fc_layers: Sequence[int] = (100, 100)
+  bias_transform_size: int = 10
+  aux_output_dim: int = 0
+
+  @nn.compact
+  def __call__(self, feature_points: jnp.ndarray,
+               aux_input: Optional[jnp.ndarray] = None):
+    net = feature_points
+    if aux_input is not None:
+      net = jnp.concatenate([net, aux_input], axis=-1)
+    # Bias transform: a learned constant concatenated to the features
+    # (helps MAML adapt biases; ref :270's bias_transform).
+    if self.bias_transform_size:
+      bias = self.param('bias_transform', nn.initializers.zeros,
+                        (self.bias_transform_size,), jnp.float32)
+      tiled = jnp.broadcast_to(
+          bias.astype(net.dtype),
+          net.shape[:-1] + (self.bias_transform_size,))
+      net = jnp.concatenate([net, tiled], axis=-1)
+    for width in self.fc_layers:
+      net = nn.Dense(width)(net)
+      net = nn.LayerNorm()(net)
+      net = nn.relu(net)
+    if self.num_outputs is None:
+      return net
+    pose = nn.Dense(self.num_outputs)(net)
+    if self.aux_output_dim:
+      aux_pred = nn.Dense(self.aux_output_dim, name='aux_dense')(net)
+      return pose, aux_pred
+    return pose

@@ -1,0 +1,23 @@
+"""Trainer harness: mesh-sharded jitted train/eval loops + checkpointing."""
+
+from tensor2robot_tpu.trainer.checkpointing import (
+    CheckpointManager,
+    checkpoints_iterator,
+    create_warm_start_fn,
+    latest_checkpoint_step,
+)
+from tensor2robot_tpu.trainer.train_eval import (
+    Trainer,
+    provide_input_generator_with_model_information,
+    train_eval_model,
+)
+
+__all__ = [
+    'CheckpointManager',
+    'Trainer',
+    'checkpoints_iterator',
+    'create_warm_start_fn',
+    'latest_checkpoint_step',
+    'provide_input_generator_with_model_information',
+    'train_eval_model',
+]

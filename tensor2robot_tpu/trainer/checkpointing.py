@@ -1,0 +1,212 @@
+"""Checkpoint management: async Orbax save/restore with the reference's
+retention and warm-start semantics.
+
+Parity targets:
+  * TF1 Saver registered in SAVERS + keep policy
+    (/root/reference/models/abstract_model.py:782-793,:84-85)
+  * async checkpointing via AsyncCheckpointSaverHook
+    (/root/reference/hooks/async_export_hook_builder.py:128)
+  * warm start / partial restore from a foreign checkpoint
+    (/root/reference/models/abstract_model.py:88-118,:372-381)
+  * eval-vs-GC race protection by snapshotting checkpoints
+    (/root/reference/utils/train_eval.py:599-667)
+  * continuous-eval checkpoints_iterator (/root/reference/utils/train_eval.py:570)
+
+Orbax gives us atomic directory commits, so the reference's tmp-file
+detection heuristics collapse to "is the step committed"; the polling
+loops survive because robot-side consumers still discover checkpoints by
+watching the filesystem (SURVEY.md §2.9 'filesystem as transport').
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+CHECKPOINT_SUBDIR = 'checkpoints'
+
+
+class CheckpointManager:
+  """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+  def __init__(self,
+               model_dir: str,
+               keep_checkpoint_max: int = 5,
+               save_interval_steps: int = 1,
+               async_checkpoints: bool = True,
+               best_fn: Optional[Callable[[Any], float]] = None,
+               best_mode: str = 'min'):
+    """Args mirror the reference's gin-exposed Saver/RunConfig knobs.
+
+    Args:
+      model_dir: root run directory; checkpoints live in
+        ``<model_dir>/checkpoints``.
+      keep_checkpoint_max: retention count (ref abstract_model.py:84).
+      save_interval_steps: dedupe interval enforced by orbax.
+      async_checkpoints: background commit thread — the
+        AsyncCheckpointSaverHook equivalent.
+      best_fn: optional metrics -> scalar for best-checkpoint retention.
+      best_mode: 'min' | 'max'.
+    """
+    self.directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=keep_checkpoint_max,
+        save_interval_steps=save_interval_steps,
+        enable_async_checkpointing=async_checkpoints,
+        best_fn=best_fn,
+        best_mode=best_mode,
+        create=True,
+    )
+    self._manager = ocp.CheckpointManager(self.directory, options=options)
+
+  def save(self, step: int, state, metrics: Optional[dict] = None,
+           force: bool = False) -> bool:
+    return self._manager.save(
+        int(step), args=ocp.args.StandardSave(state), metrics=metrics,
+        force=force)
+
+  def restore(self, state_template, step: Optional[int] = None):
+    """Restores into the structure/shardings of ``state_template``.
+
+    ``state_template`` may be a concrete pytree or one of
+    ``jax.ShapeDtypeStruct`` leaves (from ``jax.eval_shape``).
+    """
+    if step is None:
+      step = self.latest_step()
+    if step is None:
+      raise FileNotFoundError(
+          'No checkpoint found in {}.'.format(self.directory))
+    return self._manager.restore(
+        int(step), args=ocp.args.StandardRestore(state_template))
+
+  def latest_step(self) -> Optional[int]:
+    return self._manager.latest_step()
+
+  def reload(self) -> None:
+    """Re-reads the step list from disk.
+
+    Orbax caches the step list at construction; a concurrent trainer
+    process writing checkpoints (the continuous-eval topology,
+    ref train_eval.py:570) is invisible without this.
+    """
+    self._manager.reload()
+
+  def all_steps(self) -> Sequence[int]:
+    return sorted(self._manager.all_steps())
+
+  def wait_until_finished(self) -> None:
+    self._manager.wait_until_finished()
+
+  def close(self) -> None:
+    self._manager.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def latest_checkpoint_step(model_dir: str) -> Optional[int]:
+  """Newest committed checkpoint step under model_dir, or None."""
+  directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
+  if not os.path.isdir(directory):
+    return None
+  steps = []
+  for name in os.listdir(directory):
+    if name.isdigit() and not name.startswith('tmp'):
+      # Orbax commits atomically by renaming; a bare numeric dir is live.
+      steps.append(int(name))
+  return max(steps) if steps else None
+
+
+def checkpoints_iterator(model_dir: str,
+                         timeout_secs: float = 600.0,
+                         min_interval_secs: float = 1.0,
+                         stop_fn: Optional[Callable[[], bool]] = None
+                         ) -> Iterator[int]:
+  """Yields new checkpoint steps as they appear (ref train_eval.py:570).
+
+  Terminates when no new checkpoint arrives within ``timeout_secs`` or
+  ``stop_fn`` returns True.
+  """
+  last_step = None
+  deadline = time.time() + timeout_secs
+  while True:
+    if stop_fn is not None and stop_fn():
+      return
+    step = latest_checkpoint_step(model_dir)
+    if step is not None and step != last_step:
+      last_step = step
+      deadline = time.time() + timeout_secs
+      yield step
+      continue
+    if time.time() > deadline:
+      return
+    time.sleep(min_interval_secs)
+
+
+# -- warm start -------------------------------------------------------------
+
+
+def create_warm_start_fn(checkpoint_dir: str,
+                         step: Optional[int] = None,
+                         include: Optional[Callable[[str], bool]] = None):
+  """Returns params -> params merging values restored from a foreign run.
+
+  The JAX form of ``default_init_from_checkpoint_fn``'s partial restore
+  (/root/reference/models/abstract_model.py:88-118): leaves present in the
+  checkpoint under the same tree path (and passing ``include`` on the
+  '/'-joined path) replace freshly-initialized values; everything else
+  keeps its init. Shape mismatches are skipped, matching the reference's
+  tolerance for evolving label spaces.
+  """
+
+  def warm_start(params):
+    manager = CheckpointManager(checkpoint_dir, async_checkpoints=False)
+    try:
+      restore_step = step if step is not None else manager.latest_step()
+      if restore_step is None:
+        raise FileNotFoundError(
+            'No checkpoint to warm start from in {}.'.format(checkpoint_dir))
+      restored = manager.restore(None, step=restore_step)
+    finally:
+      manager.close()
+    if isinstance(restored, dict) and 'params' in restored:
+      restored = restored['params']
+
+    flat_restored = _flatten_with_paths(restored)
+    flat_params, treedef = jax.tree_util.tree_flatten_with_path(params)
+    merged = []
+    for path, value in flat_params:
+      key = _path_str(path)
+      candidate = flat_restored.get(key)
+      if candidate is not None and (include is None or include(key)):
+        if np.shape(candidate) == np.shape(value):
+          value = jax.numpy.asarray(candidate, dtype=value.dtype)
+      merged.append(value)
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+  return warm_start
+
+
+def _path_str(path) -> str:
+  parts = []
+  for entry in path:
+    if hasattr(entry, 'key'):
+      parts.append(str(entry.key))
+    elif hasattr(entry, 'idx'):
+      parts.append(str(entry.idx))
+    else:
+      parts.append(str(entry))
+  return '/'.join(parts)
+
+
+def _flatten_with_paths(tree) -> dict:
+  flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+  return {_path_str(path): value for path, value in flat}

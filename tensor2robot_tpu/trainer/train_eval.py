@@ -1,0 +1,400 @@
+"""The training/eval harness: mesh-sharded jitted train loop.
+
+Parity target: /root/reference/utils/train_eval.py:404-596 (train_eval_model
+assembling Estimator/TPUEstimator + TrainSpec/EvalSpec + exporters + hooks)
+and the model_fn skeleton it drives (/root/reference/models/abstract_model.py
+:651-823). The TF1 machinery maps as:
+
+  (TPU)Estimator + RunConfig          -> Trainer: one jitted train_step
+      donated + sharded over a Mesh; iterations are plain Python around a
+      fully-compiled XLA program (infeed == shard_batch on host arrays)
+  CrossShardOptimizer all-reduce      -> psum inserted by XLA from the
+      batch's 'data'-axis sharding — nothing to write
+  TrainSpec/EvalSpec + exporters      -> train_eval_model(): alternating
+      train/eval phases, exporters invoked after each eval
+  continuous eval (checkpoints_iterator + backup ckpt) -> eval_continuously()
+  TPU bf16 wrapper                    -> Bfloat16PreprocessorWrapper applied
+      when model.is_device_tpu (host pipeline emits bf16 arrays directly)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, TrainState
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import sharding as sharding_lib
+from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
+    Bfloat16PreprocessorWrapper,
+)
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.trainer import checkpointing
+
+_logv = None
+
+
+def _log(msg: str, *args) -> None:
+  global _logv
+  if _logv is None:
+    from absl import logging as _absl_logging  # deferred: absl optional
+    _logv = _absl_logging.info
+  _logv(msg, *args)
+
+
+def provide_input_generator_with_model_information(
+    input_generator: AbstractInputGenerator,
+    t2r_model: AbstractT2RModel,
+    mode: str) -> AbstractInputGenerator:
+  """Binds the model's (preprocessed) specs to the input generator.
+
+  Ref: utils/train_eval.py:101 + abstract_input_generator.py:80.
+  """
+  input_generator.set_specification_from_model(t2r_model, mode)
+  return input_generator
+
+
+class Trainer:
+  """Owns the mesh, the compiled step functions, and checkpointing."""
+
+  def __init__(self,
+               model: AbstractT2RModel,
+               model_dir: str,
+               mesh: Optional[Mesh] = None,
+               use_fsdp: bool = False,
+               seed: int = 0,
+               keep_checkpoint_max: int = 5,
+               save_checkpoints_steps: int = 500,
+               async_checkpoints: bool = True,
+               log_every_n_steps: int = 100,
+               use_avg_params_for_eval: Optional[bool] = None):
+    self.model = model
+    self.model_dir = model_dir
+    self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
+    self.use_fsdp = use_fsdp
+    self.seed = seed
+    self.log_every_n_steps = log_every_n_steps
+    self.save_checkpoints_steps = save_checkpoints_steps
+    if use_avg_params_for_eval is None:
+      use_avg_params_for_eval = model.use_avg_model_params
+    self.use_avg_params_for_eval = use_avg_params_for_eval
+    os.makedirs(model_dir, exist_ok=True)
+    self.checkpoint_manager = checkpointing.CheckpointManager(
+        model_dir,
+        keep_checkpoint_max=keep_checkpoint_max,
+        save_interval_steps=1,
+        async_checkpoints=async_checkpoints)
+    self._state_sharding = None
+    self._train_step_fn = None
+    self._eval_step_fn = None
+    self._predict_step_fn = None
+    self._throughput = None  # (examples/sec, step_time_s) from last train run
+    self.last_eval_state = None  # state used by the most recent evaluate()
+
+  # -- state ---------------------------------------------------------------
+
+  def _batch_sharding(self):
+    return sharding_lib.batch_sharding(self.mesh)
+
+  def init_state(self, features: SpecStruct,
+                 labels: Optional[SpecStruct]) -> TrainState:
+    """Initializes (or restores) a sharded TrainState from a sample batch."""
+    rng = jax.random.PRNGKey(self.seed)
+    abstract_state = jax.eval_shape(
+        lambda: self.model.create_train_state(rng, features, labels))
+    self._state_sharding = sharding_lib.train_state_sharding(
+        abstract_state, self.mesh, use_fsdp=self.use_fsdp)
+    # Re-read disk: a concurrent trainer may have written checkpoints
+    # since this manager was constructed (continuous-eval topology).
+    self.checkpoint_manager.reload()
+    latest = self.checkpoint_manager.latest_step()
+    if latest is not None:
+      _log('Restoring checkpoint at step %d from %s', latest, self.model_dir)
+      template = jax.tree.map(
+          lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                               sharding=s),
+          abstract_state, self._state_sharding)
+      return self.checkpoint_manager.restore(template, step=latest)
+    init_fn = jax.jit(
+        lambda f, l: self.model.create_train_state(rng, f, l),
+        out_shardings=self._state_sharding)
+    batch_sharding = self._batch_sharding()
+    features = jax.device_put(features.to_dict(), batch_sharding)
+    labels = (jax.device_put(labels.to_dict(), batch_sharding)
+              if labels is not None else None)
+    return init_fn(features, labels)
+
+  # -- compiled steps -------------------------------------------------------
+
+  def _compile_train_step(self):
+    if self._train_step_fn is not None:
+      return self._train_step_fn
+    model = self.model
+
+    def step(state, features, labels, base_rng):
+      # Fold the step into the rng on-device: no host round-trip per step.
+      rng = jax.random.fold_in(base_rng, state.step)
+      return model.train_step(state, SpecStruct(**features),
+                              SpecStruct(**labels) if labels is not None
+                              else None, rng)
+
+    batch = self._batch_sharding()
+    replicated = NamedSharding(self.mesh, P())
+    self._train_step_fn = jax.jit(
+        step,
+        in_shardings=(self._state_sharding, batch, batch, replicated),
+        out_shardings=(self._state_sharding, replicated),
+        donate_argnums=(0,))
+    return self._train_step_fn
+
+  def _compile_eval_step(self):
+    if self._eval_step_fn is not None:
+      return self._eval_step_fn
+    model = self.model
+    use_avg = self.use_avg_params_for_eval
+
+    def step(state, features, labels):
+      variables = state.variables(use_avg_params=use_avg)
+      outputs, _ = model.inference_network_fn(
+          variables, SpecStruct(**features),
+          SpecStruct(**labels) if labels is not None else None,
+          ModeKeys.EVAL, None)
+      metrics = model.model_eval_fn(
+          variables, SpecStruct(**features),
+          SpecStruct(**labels) if labels is not None else None,
+          outputs, ModeKeys.EVAL)
+      return dict(metrics)
+
+    batch = self._batch_sharding()
+    self._eval_step_fn = jax.jit(
+        step, in_shardings=(self._state_sharding, batch, batch),
+        out_shardings=NamedSharding(self.mesh, P()))
+    return self._eval_step_fn
+
+  def _compile_predict_step(self):
+    if self._predict_step_fn is not None:
+      return self._predict_step_fn
+    model = self.model
+
+    def step(state, features):
+      outputs = model.predict_step(state, SpecStruct(**features))
+      return dict(outputs)
+
+    self._predict_step_fn = jax.jit(
+        step, in_shardings=(self._state_sharding, self._batch_sharding()))
+    return self._predict_step_fn
+
+  # -- loops ----------------------------------------------------------------
+
+  def train(self,
+            input_generator: AbstractInputGenerator,
+            max_train_steps: int,
+            state: Optional[TrainState] = None,
+            hooks: Sequence[Any] = ()) -> TrainState:
+    """Runs the training loop up to global step ``max_train_steps``."""
+    input_generator = provide_input_generator_with_model_information(
+        input_generator, self.model, ModeKeys.TRAIN)
+    iterator = input_generator.create_dataset_iterator(mode=ModeKeys.TRAIN)
+    features, labels = next(iterator)
+    if state is None:
+      state = self.init_state(features, labels)
+    step_fn = self._compile_train_step()
+    base_rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
+                              NamedSharding(self.mesh, P()))
+    start_step = int(jax.device_get(state.step))
+    if start_step >= max_train_steps:
+      _log('Checkpoint already at step %d >= max_train_steps %d; skipping.',
+           start_step, max_train_steps)
+      return state
+    batch_size = int(jax.tree_util.tree_leaves(features.to_dict())[0].shape[0])
+    for hook in hooks:
+      hook.begin(self)
+    t_last = time.time()
+    steps_since_log = 0
+    metrics = None
+    step_i = start_step
+    batch = (features, labels)
+    while step_i < max_train_steps:
+      features, labels = batch
+      device_batch = sharding_lib.shard_batch(
+          {'features': features.to_dict(), 'labels': labels.to_dict()},
+          self.mesh)
+      state, metrics = step_fn(state, device_batch['features'],
+                               device_batch['labels'], base_rng)
+      step_i += 1
+      steps_since_log += 1
+      if step_i % self.log_every_n_steps == 0 or step_i == max_train_steps:
+        metrics = jax.device_get(dict(metrics))
+        dt = time.time() - t_last
+        examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
+        self._throughput = (examples_per_sec, dt / max(steps_since_log, 1))
+        _log('step %d: loss=%s (%.1f examples/sec)', step_i,
+             metrics.get('loss'), examples_per_sec)
+        t_last = time.time()
+        steps_since_log = 0
+      if step_i % self.save_checkpoints_steps == 0:
+        self.save_checkpoint(state)
+      for hook in hooks:
+        hook.after_step(self, state, step_i, metrics)
+      if step_i < max_train_steps:
+        batch = next(iterator)
+    self.save_checkpoint(state, force=True)
+    for hook in hooks:
+      hook.end(self, state)
+    return state
+
+  def evaluate(self,
+               input_generator: AbstractInputGenerator,
+               eval_steps: int,
+               state: Optional[TrainState] = None) -> Dict[str, float]:
+    """Averaged eval metrics over ``eval_steps`` batches (ref model_eval_fn)."""
+    input_generator = provide_input_generator_with_model_information(
+        input_generator, self.model, ModeKeys.EVAL)
+    iterator = input_generator.create_dataset_iterator(mode=ModeKeys.EVAL)
+    batch = next(iterator)
+    if state is None:
+      # The init batch is still scored below — no data is skipped.
+      state = self.init_state(*batch)
+    self.last_eval_state = state
+    eval_fn = self._compile_eval_step()
+    totals: Dict[str, float] = {}
+    count = 0
+    for _ in range(eval_steps):
+      if batch is None:
+        try:
+          batch = next(iterator)
+        except StopIteration:
+          break
+      features, labels = batch
+      batch = None
+      device_batch = sharding_lib.shard_batch(
+          {'features': features.to_dict(), 'labels': labels.to_dict()},
+          self.mesh)
+      metrics = jax.device_get(
+          eval_fn(state, device_batch['features'], device_batch['labels']))
+      for key, value in metrics.items():
+        totals[key] = totals.get(key, 0.0) + float(np.mean(value))
+      count += 1
+    return {k: v / max(count, 1) for k, v in totals.items()}
+
+  def predict(self, state: TrainState, features: SpecStruct
+              ) -> Dict[str, np.ndarray]:
+    """Numpy-in / numpy-out serving forward pass."""
+    device_features = sharding_lib.shard_batch(
+        SpecStruct(**features).to_dict()
+        if not isinstance(features, SpecStruct) else features.to_dict(),
+        self.mesh)
+    return jax.device_get(self._compile_predict_step()(state,
+                                                       device_features))
+
+  # -- checkpoint/export ----------------------------------------------------
+
+  def save_checkpoint(self, state: TrainState, force: bool = False) -> None:
+    step = int(jax.device_get(state.step))
+    if step in self.checkpoint_manager.all_steps():
+      return
+    if self.checkpoint_manager.save(step, state, force=force):
+      # The t2r_assets contract: feature/label specs + global step live
+      # next to the weights (ref utils/train_eval.py:296-370).
+      assets_lib.write_t2r_assets_to_file(
+          self.model.get_feature_specification(ModeKeys.TRAIN),
+          self.model.get_label_specification(ModeKeys.TRAIN),
+          step, os.path.join(self.model_dir, 'assets.extra'))
+
+  @property
+  def last_throughput(self):
+    return self._throughput
+
+  def close(self) -> None:
+    self.checkpoint_manager.wait_until_finished()
+    self.checkpoint_manager.close()
+
+
+def train_eval_model(t2r_model: AbstractT2RModel,
+                     model_dir: str,
+                     input_generator_train: Optional[AbstractInputGenerator] = None,
+                     input_generator_eval: Optional[AbstractInputGenerator] = None,
+                     max_train_steps: int = 1000,
+                     eval_steps: int = 100,
+                     eval_throttle_steps: int = 500,
+                     create_exporters_fn: Optional[Callable] = None,
+                     train_hook_builders: Sequence[Any] = (),
+                     mesh: Optional[Mesh] = None,
+                     use_fsdp: bool = False,
+                     keep_checkpoint_max: int = 5,
+                     save_checkpoints_steps: int = 500,
+                     async_checkpoints: bool = True,
+                     seed: int = 0,
+                     eval_timeout_secs: float = 30.0) -> Dict[str, Any]:
+  """Main entry point (ref utils/train_eval.py:404).
+
+  Modes, mirroring the reference's Estimator dispatch:
+    * train+eval: alternate train phases (``eval_throttle_steps`` apart)
+      with ``eval_steps``-batch evals, exporters after each eval.
+    * train-only (no eval generator): straight run to max_train_steps.
+    * eval-only (no train generator): continuous eval — poll for new
+      checkpoints until timeout (ref :552-594).
+  Returns {'state', 'eval_metrics', 'trainer'}.
+  """
+  if t2r_model.is_device_tpu:
+    # Host pipeline feeds bf16 directly (ref TPUPreprocessorWrapper).
+    preprocessor = t2r_model.preprocessor
+    if not isinstance(preprocessor, Bfloat16PreprocessorWrapper):
+      t2r_model._preprocessor = Bfloat16PreprocessorWrapper(preprocessor)
+
+  trainer = Trainer(
+      t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp, seed=seed,
+      keep_checkpoint_max=keep_checkpoint_max,
+      save_checkpoints_steps=save_checkpoints_steps,
+      async_checkpoints=async_checkpoints)
+
+  hooks: List[Any] = []
+  for builder in train_hook_builders:
+    hooks.extend(builder.create_hooks(t2r_model, trainer))
+
+  exporters = (create_exporters_fn(t2r_model) if create_exporters_fn
+               else [])
+
+  state = None
+  eval_metrics: Dict[str, float] = {}
+
+  def _run_exporters(current_state, metrics):
+    for exporter in exporters:
+      exporter.export(trainer, current_state, metrics)
+
+  try:
+    if input_generator_train is not None and input_generator_eval is not None:
+      target = 0
+      while target < max_train_steps:
+        target = min(target + eval_throttle_steps, max_train_steps)
+        state = trainer.train(input_generator_train, target, state=state,
+                              hooks=hooks)
+        eval_metrics = trainer.evaluate(input_generator_eval, eval_steps,
+                                        state=state)
+        _log('eval @ step %d: %s', target, eval_metrics)
+        _run_exporters(state, eval_metrics)
+    elif input_generator_train is not None:
+      state = trainer.train(input_generator_train, max_train_steps,
+                            hooks=hooks)
+    elif input_generator_eval is not None:
+      for step in checkpointing.checkpoints_iterator(
+          model_dir, timeout_secs=eval_timeout_secs):
+        # state=None: evaluate re-restores the newest checkpoint itself.
+        eval_metrics = trainer.evaluate(input_generator_eval, eval_steps)
+        _log('continuous eval @ ckpt %d: %s', step, eval_metrics)
+        state = trainer.last_eval_state
+        _run_exporters(state, eval_metrics)
+    else:
+      raise ValueError('Provide at least one of train/eval input generators.')
+  finally:
+    trainer.close()
+  return {'state': state, 'eval_metrics': eval_metrics, 'trainer': trainer}

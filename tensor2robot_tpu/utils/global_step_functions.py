@@ -1,0 +1,51 @@
+"""Hyperparameter schedules as functions of the global step.
+
+Parity target: /root/reference/utils/global_step_functions.py
+(piecewise_linear :33, exponential_decay :104) — configurable schedules
+for any scalar hyperparameter. These are plain jnp functions so they work
+both inside jit (as optax-style schedules) and on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def piecewise_linear(boundaries: Sequence[int],
+                     values: Sequence[float]):
+  """Linear interpolation between (boundary, value) knots (ref :33).
+
+  Before the first boundary the first value holds; after the last, the
+  last value holds; in between, linear interpolation.
+  """
+  if len(boundaries) != len(values):
+    raise ValueError(
+        'boundaries and values must have equal length; got {} vs {}.'.format(
+            len(boundaries), len(values)))
+  if list(boundaries) != sorted(boundaries):
+    raise ValueError('boundaries must be sorted ascending.')
+  boundaries_arr = jnp.asarray(boundaries, jnp.float32)
+  values_arr = jnp.asarray(values, jnp.float32)
+
+  def schedule(global_step):
+    step = jnp.asarray(global_step, jnp.float32)
+    return jnp.interp(step, boundaries_arr, values_arr)
+
+  return schedule
+
+
+def exponential_decay(initial_value: float = 0.0001,
+                      decay_steps: int = 10000,
+                      decay_rate: float = 0.9,
+                      staircase: bool = True):
+  """value * decay_rate^(step/decay_steps) (ref :104)."""
+
+  def schedule(global_step):
+    exponent = jnp.asarray(global_step, jnp.float32) / decay_steps
+    if staircase:
+      exponent = jnp.floor(exponent)
+    return initial_value * decay_rate ** exponent
+
+  return schedule

@@ -1,0 +1,37 @@
+"""Image encode/decode helpers.
+
+Parity target: /root/reference/utils/image.py (jpeg_string :29,
+numpy_to_image_string :49) — numpy image -> encoded bytes for writing
+tf.Example replay records.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+from PIL import Image
+
+
+def jpeg_string(image: 'Image.Image', jpeg_quality: int = 90) -> bytes:
+  """Encodes a PIL image as JPEG bytes (ref image.py:29)."""
+  buf = io.BytesIO()
+  image.save(buf, format='JPEG', quality=jpeg_quality)
+  return buf.getvalue()
+
+
+def numpy_to_image_string(image_array: np.ndarray,
+                          image_format: str = 'jpeg',
+                          data_type=np.uint8) -> bytes:
+  """Encodes [H, W, C] numpy array to an image byte string (ref :49)."""
+  image_array = np.asarray(image_array, dtype=data_type)
+  image = Image.fromarray(image_array)
+  buf = io.BytesIO()
+  image.save(buf, format=image_format.upper())
+  return buf.getvalue()
+
+
+def image_string_to_numpy(image_bytes: bytes) -> np.ndarray:
+  """Decodes encoded image bytes back to a numpy array."""
+  with io.BytesIO(image_bytes) as buf:
+    return np.asarray(Image.open(buf))

@@ -1,0 +1,88 @@
+"""Fixed-length subsampling of variable-length sequences.
+
+Parity target: /root/reference/utils/subsample.py (get_subsample_indices
+:25, randomized-boundary variant :84, numpy variant :162): pick
+``sequence_length`` frames from an episode of ``len`` steps, always
+including the first and last frame, evenly spaced (optionally with random
+jitter inside each span).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_subsample_indices_numpy(sequence_lengths: np.ndarray,
+                                sequence_length: int,
+                                rng: Optional[np.random.RandomState] = None,
+                                randomized: bool = False) -> np.ndarray:
+  """[batch] episode lengths -> [batch, sequence_length] frame indices."""
+  sequence_lengths = np.asarray(sequence_lengths)
+  batch = sequence_lengths.shape[0]
+  out = np.zeros((batch, sequence_length), np.int64)
+  rng = rng or np.random.RandomState()
+  for i, length in enumerate(sequence_lengths):
+    out[i] = _single_subsample_numpy(int(length), sequence_length,
+                                     rng if randomized else None)
+  return out
+
+
+def _single_subsample_numpy(length: int, k: int,
+                            rng: Optional[np.random.RandomState]
+                            ) -> np.ndarray:
+  if length <= k:
+    # Short episodes: keep everything, pad by repeating the last frame.
+    idx = np.arange(k)
+    return np.minimum(idx, max(length - 1, 0))
+  # k spans over [0, length); first index 0, last index length-1.
+  boundaries = np.linspace(0, length - 1, k)
+  if rng is None:
+    return np.round(boundaries).astype(np.int64)
+  # Randomized: jitter each midpoint within its span, keep endpoints.
+  low = np.floor(np.linspace(0, length - 1, k + 1)[:-1])
+  high = np.ceil(np.linspace(0, length - 1, k + 1)[1:])
+  picks = np.array([rng.randint(int(l), max(int(h), int(l) + 1))
+                    for l, h in zip(low, high)], np.int64)
+  picks[0] = 0
+  picks[-1] = length - 1
+  return np.clip(picks, 0, length - 1)
+
+
+def get_subsample_indices(sequence_lengths: jnp.ndarray,
+                          sequence_length: int,
+                          rng: Optional[jax.Array] = None) -> jnp.ndarray:
+  """JAX variant: static output shape, traceable under jit.
+
+  Randomization is enabled by passing ``rng``.
+  """
+  sequence_lengths = jnp.asarray(sequence_lengths)
+
+  def one(length, key):
+    length = jnp.maximum(length, 1)
+    positions = jnp.linspace(0.0, 1.0, sequence_length)
+    base = positions * (length - 1).astype(jnp.float32)
+    if key is not None:
+      span = (length - 1).astype(jnp.float32) / jnp.maximum(
+          sequence_length - 1, 1)
+      jitter = (jax.random.uniform(key, (sequence_length,)) - 0.5) * span
+      # Endpoints stay pinned to first/last frame.
+      jitter = jitter.at[0].set(0.0).at[-1].set(0.0)
+      base = base + jitter
+    idx = jnp.clip(jnp.round(base).astype(jnp.int32), 0, length - 1)
+    return idx
+
+  if rng is None:
+    return jax.vmap(lambda l: one(l, None))(sequence_lengths)
+  keys = jax.random.split(rng, sequence_lengths.shape[0])
+  return jax.vmap(one)(sequence_lengths, keys)
+
+
+def subsample_sequence(tensor, indices):
+  """Gathers [batch, time, ...] frames by per-batch [batch, k] indices."""
+  if isinstance(tensor, np.ndarray):
+    return np.stack([tensor[i, indices[i]] for i in range(tensor.shape[0])])
+  return jax.vmap(lambda x, idx: jnp.take(x, idx, axis=0))(tensor, indices)

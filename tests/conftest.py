@@ -8,13 +8,16 @@ TPU-without-TPU estimator tests).
 import os
 
 # Force-override: the ambient environment pins JAX_PLATFORMS=axon (the
-# tunneled TPU). Tests must run on the virtual CPU mesh — the TPU tunnel
-# serializes every process behind a single-chip lease, so accidentally
-# running the suite there both slows it ~10x and wedges concurrent work.
+# tunneled TPU) and a sitecustomize hook registers that backend at
+# interpreter start — before this conftest runs, so env vars alone are too
+# late. Tests must run on the virtual CPU mesh — the TPU tunnel serializes
+# every process behind a single-chip lease, so accidentally running the
+# suite there both slows it ~10x and wedges concurrent work. jax.config
+# updates still win as long as they land before first backend use.
 os.environ['JAX_PLATFORMS'] = 'cpu'
-xla_flags = os.environ.get('XLA_FLAGS', '')
-if 'xla_force_host_platform_device_count' not in xla_flags:
-  os.environ['XLA_FLAGS'] = (
-      xla_flags + ' --xla_force_host_platform_device_count=8').strip()
-# Keep compilation deterministic and quiet in tests.
 os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)

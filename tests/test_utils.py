@@ -1,0 +1,150 @@
+"""Tests for CEM, subsampling, schedules, and image helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.utils import cross_entropy, global_step_functions
+from tensor2robot_tpu.utils import image as image_lib
+from tensor2robot_tpu.utils import subsample
+
+
+class TestCrossEntropy:
+
+  def test_normal_cem_finds_quadratic_max(self):
+    """CEM on -(x - 3)^2 converges toward x = 3 (ref cross_entropy tests)."""
+    target = np.array([3.0, -1.0])
+    np.random.seed(0)
+
+    def objective(samples):
+      return -np.sum((samples - target) ** 2, axis=-1)
+
+    mean, stddev = cross_entropy.normal_cross_entropy_method(
+        objective, mean=np.zeros(2), stddev=np.ones(2) * 2.0,
+        num_samples=128, num_elites=10, num_iterations=10)
+    np.testing.assert_allclose(mean, target, atol=0.3)
+    assert np.all(np.asarray(stddev) < 1.0)
+
+  def test_generic_cem_dict_batches_and_early_exit(self):
+    """Dict sample batches + threshold_to_terminate (ref :35 contract)."""
+    calls = []
+
+    def sample_fn(mean):
+      batch = mean + np.random.RandomState(len(calls)).randn(32, 1)
+      calls.append(1)
+      return {'x': batch}
+
+    def objective(samples):
+      return -np.abs(np.asarray(samples['x'])[:, 0] - 2.0)
+
+    def update_fn(params, elites):
+      return {'mean': np.mean(elites['x'], axis=0)}
+
+    samples, values, params = cross_entropy.cross_entropy_method(
+        sample_fn, objective, update_fn, {'mean': np.zeros(1)},
+        num_elites=4, num_iterations=50, threshold_to_terminate=-0.05)
+    assert len(calls) < 50  # early exit triggered
+    assert abs(float(params['mean'][0]) - 2.0) < 0.5
+    assert set(samples) == {'x'} and len(values) == 32
+
+  def test_jax_cem_matches_numpy_quality(self):
+    target = jnp.asarray([1.5, 0.5])
+
+    def objective(samples):
+      return -jnp.sum((samples - target) ** 2, axis=-1)
+
+    mean, stddev, best = cross_entropy.jax_normal_cem(
+        objective, jnp.zeros(2), jnp.ones(2) * 2.0,
+        jax.random.PRNGKey(0), num_samples=128, num_elites=10,
+        num_iterations=10)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(target),
+                               atol=0.3)
+
+  def test_jax_cem_jittable(self):
+    def objective(samples):
+      return -jnp.sum(samples ** 2, axis=-1)
+
+    jitted = jax.jit(lambda rng: cross_entropy.jax_normal_cem(
+        objective, jnp.ones(3), jnp.ones(3), rng))
+    mean, _, _ = jitted(jax.random.PRNGKey(1))
+    assert np.all(np.abs(np.asarray(mean)) < 1.0)
+
+
+class TestSubsample:
+
+  def test_numpy_includes_endpoints(self):
+    idx = subsample.get_subsample_indices_numpy(np.array([40, 25]), 5)
+    assert idx.shape == (2, 5)
+    assert idx[0, 0] == 0 and idx[0, -1] == 39
+    assert idx[1, 0] == 0 and idx[1, -1] == 24
+    assert np.all(np.diff(idx, axis=1) >= 0)
+
+  def test_numpy_short_episode_pads(self):
+    idx = subsample.get_subsample_indices_numpy(np.array([3]), 5)
+    np.testing.assert_array_equal(idx[0], [0, 1, 2, 2, 2])
+
+  def test_numpy_randomized_endpoints_pinned(self):
+    rng = np.random.RandomState(0)
+    idx = subsample.get_subsample_indices_numpy(
+        np.array([50]), 6, rng=rng, randomized=True)
+    assert idx[0, 0] == 0 and idx[0, -1] == 49
+
+  def test_jax_variant_endpoints(self):
+    idx = subsample.get_subsample_indices(jnp.asarray([40, 25]), 5)
+    idx = np.asarray(idx)
+    assert idx[0, 0] == 0 and idx[0, -1] == 39
+    assert idx[1, -1] == 24
+
+  def test_jax_randomized_within_bounds(self):
+    idx = subsample.get_subsample_indices(
+        jnp.asarray([30]), 7, rng=jax.random.PRNGKey(0))
+    idx = np.asarray(idx)
+    assert idx[0, 0] == 0 and idx[0, -1] == 29
+    assert np.all(idx >= 0) and np.all(idx < 30)
+
+  def test_subsample_sequence_gather(self):
+    data = np.arange(2 * 10 * 3).reshape(2, 10, 3)
+    idx = np.array([[0, 5, 9], [1, 2, 3]])
+    out = subsample.subsample_sequence(data, idx)
+    np.testing.assert_array_equal(out[0, 1], data[0, 5])
+    np.testing.assert_array_equal(out[1, 2], data[1, 3])
+
+
+class TestGlobalStepFunctions:
+
+  def test_piecewise_linear(self):
+    schedule = global_step_functions.piecewise_linear(
+        [100, 200], [1.0, 0.0])
+    assert float(schedule(0)) == 1.0
+    assert float(schedule(150)) == pytest.approx(0.5)
+    assert float(schedule(300)) == 0.0
+
+  def test_piecewise_linear_validation(self):
+    with pytest.raises(ValueError, match='equal length'):
+      global_step_functions.piecewise_linear([1], [1.0, 2.0])
+    with pytest.raises(ValueError, match='sorted'):
+      global_step_functions.piecewise_linear([5, 1], [1.0, 2.0])
+
+  def test_exponential_decay_staircase(self):
+    schedule = global_step_functions.exponential_decay(
+        initial_value=1.0, decay_steps=10, decay_rate=0.5, staircase=True)
+    assert float(schedule(9)) == 1.0
+    assert float(schedule(10)) == pytest.approx(0.5)
+    assert float(schedule(25)) == pytest.approx(0.25)
+
+
+class TestImage:
+
+  def test_jpeg_roundtrip(self):
+    array = (np.random.RandomState(0).rand(16, 24, 3) * 255).astype(np.uint8)
+    encoded = image_lib.numpy_to_image_string(array, 'jpeg')
+    assert encoded[:2] == b'\xff\xd8'  # JPEG magic
+    decoded = image_lib.image_string_to_numpy(encoded)
+    assert decoded.shape == (16, 24, 3)
+
+  def test_png_roundtrip_lossless(self):
+    array = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+    encoded = image_lib.numpy_to_image_string(array, 'png')
+    decoded = image_lib.image_string_to_numpy(encoded)
+    np.testing.assert_array_equal(decoded, array)
