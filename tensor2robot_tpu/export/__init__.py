@@ -1,0 +1,27 @@
+"""Model export: versioned serving artifacts with the t2r_assets contract."""
+
+from tensor2robot_tpu.export.export_generators import (
+    AbstractExportGenerator,
+    DefaultExportGenerator,
+    VARIABLES_SUBDIR,
+    list_exported_versions,
+    load_exported_variables,
+    write_serving_artifact,
+)
+from tensor2robot_tpu.export.exporters import (
+    BestModelExporter,
+    LatestModelExporter,
+    create_default_exporters,
+)
+
+__all__ = [
+    'AbstractExportGenerator',
+    'BestModelExporter',
+    'DefaultExportGenerator',
+    'LatestModelExporter',
+    'VARIABLES_SUBDIR',
+    'create_default_exporters',
+    'list_exported_versions',
+    'load_exported_variables',
+    'write_serving_artifact',
+]

@@ -1,0 +1,127 @@
+"""Latest/Best exporters invoked after each eval (ref utils/train_eval.py:296-370).
+
+The reference wires Estimator ``LatestExporter``/``BestExporter`` pairs (numpy
+and tf_example receivers) into the EvalSpec; each writes a SavedModel with
+``t2r_assets.pbtxt``. Here exporters are plain objects called by
+``train_eval_model`` after every eval phase with ``(trainer, state, metrics)``;
+each writes a versioned serving artifact (export_generators.py) and applies
+its retention policy. One artifact serves both receiver styles — the predictor
+accepts numpy dicts or serialized examples against the same specs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from tensor2robot_tpu.export import export_generators
+
+EXPORT_SUBDIR = 'export'
+
+
+def _loss_compare_fn(best: Optional[Dict[str, float]],
+                     current: Dict[str, float],
+                     key: str = 'loss') -> bool:
+  """True when current beats best. Robust to missing keys (ref :207-292)."""
+  if current is None or key not in current:
+    return False
+  if best is None or key not in best:
+    return True
+  return float(current[key]) < float(best[key])
+
+
+class _ExporterBase:
+  """Shared: resolve export root, write one artifact, GC old versions."""
+
+  def __init__(self, name: str,
+               export_generator: Optional[
+                   export_generators.AbstractExportGenerator] = None,
+               exports_to_keep: int = 5,
+               use_avg_params: Optional[bool] = None):
+    self.name = name
+    self._export_generator = (export_generator or
+                              export_generators.DefaultExportGenerator())
+    self._exports_to_keep = exports_to_keep
+    self._use_avg_params = use_avg_params
+
+  def export_root(self, trainer) -> str:
+    return os.path.join(trainer.model_dir, EXPORT_SUBDIR, self.name)
+
+  def _write(self, trainer, state) -> str:
+    model = trainer.model
+    self._export_generator.set_specification_from_model(model)
+    use_avg = (model.use_avg_model_params if self._use_avg_params is None
+               else self._use_avg_params)
+    variables = jax.device_get(state.variables(use_avg_params=use_avg))
+    step = int(jax.device_get(state.step))
+    path = self._export_generator.export(self.export_root(trainer), variables,
+                                         step)
+    export_generators.garbage_collect_versions(self.export_root(trainer),
+                                               self._exports_to_keep)
+    return path
+
+  def export(self, trainer, state, eval_metrics) -> Optional[str]:
+    raise NotImplementedError
+
+
+class LatestModelExporter(_ExporterBase):
+  """Exports after every eval, keeping the newest N (ref LatestExporter)."""
+
+  def __init__(self, name: str = 'latest_exporter', **kwargs):
+    super().__init__(name=name, **kwargs)
+
+  def export(self, trainer, state, eval_metrics) -> Optional[str]:
+    del eval_metrics
+    return self._write(trainer, state)
+
+
+class BestModelExporter(_ExporterBase):
+  """Exports only on metric improvement (ref BestExporter + compare fns).
+
+  The best metric survives process restarts via a json state file next to
+  the exports, mirroring the reference's event-file-derived best tracking.
+  """
+
+  def __init__(self, name: str = 'best_exporter', metric_key: str = 'loss',
+               **kwargs):
+    super().__init__(name=name, **kwargs)
+    self._metric_key = metric_key
+
+  def _state_path(self, trainer) -> str:
+    return os.path.join(self.export_root(trainer), 'best_metrics.json')
+
+  def _load_best(self, trainer) -> Optional[Dict[str, Any]]:
+    try:
+      with open(self._state_path(trainer)) as f:
+        return json.load(f)
+    except (OSError, ValueError):
+      return None
+
+  def export(self, trainer, state, eval_metrics) -> Optional[str]:
+    best = self._load_best(trainer)
+    if not _loss_compare_fn(best, eval_metrics, self._metric_key):
+      return None
+    path = self._write(trainer, state)
+    os.makedirs(self.export_root(trainer), exist_ok=True)
+    with open(self._state_path(trainer), 'w') as f:
+      json.dump({self._metric_key: float(eval_metrics[self._metric_key])}, f)
+    return path
+
+
+def create_default_exporters(t2r_model,
+                             export_generator: Optional[
+                                 export_generators.AbstractExportGenerator] = None,
+                             exports_to_keep: int = 5,
+                             metric_key: str = 'loss'):
+  """Best + Latest exporter pair (ref utils/train_eval.py:296)."""
+  del t2r_model  # bound per-export via set_specification_from_model
+  return [
+      BestModelExporter(export_generator=export_generator,
+                        exports_to_keep=exports_to_keep,
+                        metric_key=metric_key),
+      LatestModelExporter(export_generator=export_generator,
+                          exports_to_keep=exports_to_keep),
+  ]

@@ -1,0 +1,22 @@
+"""Training hooks: periodic export, lagged target-network dirs, logging."""
+
+from tensor2robot_tpu.hooks.hook_builder import HookBuilder, TrainHook
+from tensor2robot_tpu.hooks.checkpoint_hooks import (
+    CheckpointExportHook,
+    LaggedCheckpointExportHook,
+)
+from tensor2robot_tpu.hooks.async_export_hook_builder import (
+    AsyncExportHookBuilder,
+)
+from tensor2robot_tpu.hooks.td3 import TD3Hooks
+from tensor2robot_tpu.hooks.variable_logger_hook import VariableLoggerHook
+
+__all__ = [
+    'AsyncExportHookBuilder',
+    'CheckpointExportHook',
+    'HookBuilder',
+    'LaggedCheckpointExportHook',
+    'TD3Hooks',
+    'TrainHook',
+    'VariableLoggerHook',
+]

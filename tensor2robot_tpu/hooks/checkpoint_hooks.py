@@ -1,0 +1,117 @@
+"""Export hooks reproducing the export-dir + lagged-dir filesystem contracts.
+
+Parity target: /root/reference/hooks/checkpoint_hooks.py:36-206.
+  * CheckpointExportListener (:56-93): after every checkpoint save, write a
+    serving artifact so robot-side predictors can poll fresh weights during
+    training.
+  * LaggedCheckpointListener (:96-206): additionally maintain a
+    one-version-LAGGED export dir — TD3 target networks implemented through
+    the filesystem: actors read the lagged dir for the target Q.
+  * _DirectoryVersionGC (:36): bounded version retention in both dirs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+import jax
+
+from tensor2robot_tpu.export import export_generators
+from tensor2robot_tpu.hooks.hook_builder import TrainHook
+
+# ref _DirectoryVersionGC (:36): bounded retention, shared with exporters.
+_gc_versions = export_generators.garbage_collect_versions
+
+
+class CheckpointExportHook(TrainHook):
+  """Exports a serving artifact every ``export_every_steps`` (ref :56-93)."""
+
+  def __init__(self,
+               export_dir: str,
+               export_every_steps: int = 500,
+               exports_to_keep: int = 5,
+               export_generator=None,
+               batch_size: int = 1):
+    self._export_dir = export_dir
+    self._export_every_steps = export_every_steps
+    self._exports_to_keep = exports_to_keep
+    self._export_generator = (export_generator or
+                              export_generators.DefaultExportGenerator())
+    self._batch_size = batch_size
+    self._last_exported_step: Optional[int] = None
+
+  @property
+  def export_dir(self) -> str:
+    return self._export_dir
+
+  def _export(self, trainer, state) -> Optional[str]:
+    step = int(jax.device_get(state.step))
+    if step == self._last_exported_step:
+      return None
+    self._export_generator.set_specification_from_model(trainer.model)
+    variables = jax.device_get(
+        state.variables(use_avg_params=trainer.model.use_avg_model_params))
+    path = self._export_generator.export(
+        self._export_dir, variables, step, batch_size=self._batch_size)
+    self._last_exported_step = step
+    self._after_export(path)
+    _gc_versions(self._export_dir, self._exports_to_keep)
+    return path
+
+  def _after_export(self, path: str) -> None:
+    pass
+
+  def after_step(self, trainer, state, step: int, metrics) -> None:
+    if step % self._export_every_steps == 0:
+      self._export(trainer, state)
+
+  def end(self, trainer, state) -> None:
+    self._export(trainer, state)
+
+
+class LaggedCheckpointExportHook(CheckpointExportHook):
+  """Maintains latest + one-version-lagged export dirs (ref :96-206).
+
+  On each export: the previously-newest version is mirrored into
+  ``lagged_export_dir`` BEFORE the new version lands in ``export_dir``, so a
+  reader of the lagged dir always sees weights exactly one export behind —
+  the reference's filesystem-as-target-network trick for TD3.
+  """
+
+  def __init__(self, export_dir: str, lagged_export_dir: str, **kwargs):
+    super().__init__(export_dir, **kwargs)
+    self._lagged_export_dir = lagged_export_dir
+
+  @property
+  def lagged_export_dir(self) -> str:
+    return self._lagged_export_dir
+
+  def _export(self, trainer, state):
+    step = int(jax.device_get(state.step))
+    if step == self._last_exported_step:
+      # No new export will land (end-of-train dedupe): do NOT advance the
+      # lagged dir, or the target network would catch up to the live one.
+      return None
+    latest = export_generators.list_exported_versions(self._export_dir)
+    if latest:
+      newest = str(latest[-1])
+      lagged_target = os.path.join(self._lagged_export_dir, newest)
+      if not os.path.isdir(lagged_target):
+        os.makedirs(self._lagged_export_dir, exist_ok=True)
+        tmp = os.path.join(self._lagged_export_dir, 'tmp-' + newest)
+        shutil.copytree(os.path.join(self._export_dir, newest), tmp)
+        os.rename(tmp, lagged_target)  # atomic: pollers never see partials
+        _gc_versions(self._lagged_export_dir, self._exports_to_keep)
+    path = super()._export(trainer, state)
+    if path is not None and not export_generators.list_exported_versions(
+        self._lagged_export_dir):
+      # First export ever: seed the lagged dir so TD3 actors can start
+      # immediately (ref :96 initial-copy behavior).
+      newest = os.path.basename(path)
+      tmp = os.path.join(self._lagged_export_dir, 'tmp-' + newest)
+      os.makedirs(self._lagged_export_dir, exist_ok=True)
+      shutil.copytree(path, tmp)
+      os.rename(tmp, os.path.join(self._lagged_export_dir, newest))
+    return path

@@ -1,0 +1,36 @@
+"""Hook protocol + builder interface.
+
+Parity target: /root/reference/hooks/hook_builder.py:32-48 (HookBuilder
+creating SessionRunHooks for the Estimator). Here hooks are plain objects the
+Trainer calls around its jitted step loop:
+
+  begin(trainer)                       once, before the first step
+  after_step(trainer, state, step, metrics)   every step (metrics may be a
+                                       device pytree except on log steps)
+  end(trainer, state)                  once, after the last step
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class TrainHook:
+  """No-op base hook; subclasses override what they need."""
+
+  def begin(self, trainer) -> None:
+    pass
+
+  def after_step(self, trainer, state, step: int,
+                 metrics: Optional[Any]) -> None:
+    pass
+
+  def end(self, trainer, state) -> None:
+    pass
+
+
+class HookBuilder:
+  """Creates hooks bound to a model + trainer (ref hook_builder.py:32)."""
+
+  def create_hooks(self, t2r_model, trainer) -> List[TrainHook]:
+    raise NotImplementedError

@@ -1,0 +1,46 @@
+"""TD3Hooks: lagged-export wiring for filesystem target networks.
+
+Parity target: /root/reference/hooks/td3.py:40-135 — builds the latest +
+lagged export-dir pair (the TD3 target network lives one export behind) and
+writes warmup requests into each artifact (the export generator already
+bundles spec-conforming warmup features, abstract_export_generator.py:114-147).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from tensor2robot_tpu.hooks.checkpoint_hooks import LaggedCheckpointExportHook
+from tensor2robot_tpu.hooks.hook_builder import HookBuilder, TrainHook
+
+
+class TD3Hooks(HookBuilder):
+  """Latest + lagged serving exports for actor/target decoupling (ref :40)."""
+
+  def __init__(self,
+               export_dir: str = '',
+               lagged_export_dir: str = '',
+               save_steps: int = 500,
+               exports_to_keep: int = 5,
+               export_generator=None):
+    self._export_dir = export_dir
+    self._lagged_export_dir = lagged_export_dir
+    self._save_steps = save_steps
+    self._exports_to_keep = exports_to_keep
+    self._export_generator = export_generator
+
+  def create_hooks(self, t2r_model, trainer) -> List[TrainHook]:
+    del t2r_model
+    export_dir = self._export_dir or os.path.join(
+        trainer.model_dir, 'export', 'latest_exporter')
+    lagged_dir = self._lagged_export_dir or os.path.join(
+        trainer.model_dir, 'export', 'lagged_exporter')
+    return [
+        LaggedCheckpointExportHook(
+            export_dir,
+            lagged_dir,
+            export_every_steps=self._save_steps,
+            exports_to_keep=self._exports_to_keep,
+            export_generator=self._export_generator)
+    ]

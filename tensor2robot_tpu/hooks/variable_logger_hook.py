@@ -1,0 +1,44 @@
+"""VariableLoggerHook: periodic parameter statistics logging.
+
+Parity target: /root/reference/hooks/variable_logger_hook.py:33-68 (logs
+mean/std/values of every variable per run). One device_get per log interval;
+never inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.hooks.hook_builder import TrainHook
+
+
+class VariableLoggerHook(TrainHook):
+  """Logs per-variable mean/std every ``log_every_n_steps`` steps."""
+
+  def __init__(self, log_every_n_steps: int = 100, log_values: bool = False,
+               max_num_variable_values: int = 16):
+    self._log_every_n_steps = log_every_n_steps
+    self._log_values = log_values
+    self._max_num_variable_values = max_num_variable_values
+    self._log_fn = None
+
+  def _log(self, msg, *args):
+    if self._log_fn is None:
+      from absl import logging
+      self._log_fn = logging.info
+    self._log_fn(msg, *args)
+
+  def after_step(self, trainer, state, step: int, metrics) -> None:
+    if step % self._log_every_n_steps != 0:
+      return
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        jax.device_get(state.params))
+    for path, value in flat:
+      name = '/'.join(str(getattr(p, 'key', p)) for p in path)
+      value = np.asarray(value)
+      self._log('var %s: shape=%s mean=%.6f std=%.6f', name, value.shape,
+                float(value.mean()), float(value.std()))
+      if self._log_values:
+        self._log('var %s values: %s', name,
+                  value.ravel()[:self._max_num_variable_values])

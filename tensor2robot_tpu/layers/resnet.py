@@ -182,7 +182,7 @@ class ResNet(nn.Module):
     if include_head:
       x = nn.Dense(self.num_classes, dtype=jnp.float32,
                    name='final_dense')(x)
-    endpoints['final_dense'] = x
+      endpoints['final_dense'] = x
     return x, endpoints
 
 

@@ -115,6 +115,14 @@ class AbstractT2RModel(ModelInterface):
                                self.get_label_specification)
     return self._preprocessor
 
+  def set_preprocessor(self, preprocessor: AbstractPreprocessor) -> None:
+    """Installs a (wrapped) preprocessor, e.g. the bf16 TPU wrapper."""
+    self._preprocessor = preprocessor
+
+  @property
+  def warm_start_fn(self):
+    return self._warm_start_fn
+
   @property
   def device_type(self) -> str:
     return self._device_type
@@ -137,7 +145,13 @@ class AbstractT2RModel(ModelInterface):
         {'params': param_rng, 'dropout': dropout_rng}, features, mode=mode,
         train=(mode == ModeKeys.TRAIN))
     variables = flax.core.unfreeze(variables)
-    if self._warm_start_fn is not None:
+    if self._warm_start_fn is not None and not any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(variables)):
+      # Warm start does real checkpoint I/O; only run it on concrete values.
+      # Under jit/eval_shape the trainer is responsible for applying it
+      # eagerly exactly once (Trainer.init_state), never inside a trace
+      # where the restored weights would be baked in as XLA constants.
       variables['params'] = self._warm_start_fn(variables['params'])
     return variables
 

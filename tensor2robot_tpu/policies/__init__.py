@@ -1,0 +1,23 @@
+"""Policies: predictor-backed action selection for robot control loops."""
+
+from tensor2robot_tpu.policies.policies import (
+    CEMPolicy,
+    LSTMCEMPolicy,
+    OUExploreRegressionPolicy,
+    PerEpisodeSwitchPolicy,
+    Policy,
+    RegressionPolicy,
+    ScheduledExplorationRegressionPolicy,
+    SequentialRegressionPolicy,
+)
+
+__all__ = [
+    'CEMPolicy',
+    'LSTMCEMPolicy',
+    'OUExploreRegressionPolicy',
+    'PerEpisodeSwitchPolicy',
+    'Policy',
+    'RegressionPolicy',
+    'ScheduledExplorationRegressionPolicy',
+    'SequentialRegressionPolicy',
+]

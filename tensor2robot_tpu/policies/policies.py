@@ -1,0 +1,294 @@
+"""Policies that use predictors for robot-time action selection.
+
+Parity target: /root/reference/policies/policies.py:39-370. The full family:
+Policy base (SelectAction/reset/restore/sample_action adapter), CEMPolicy
+(+LSTM hidden-state variant), RegressionPolicy (+sequential/OU-noise/
+scheduled-noise variants), and PerEpisodeSwitchPolicy.
+
+The CEM hot loop (SURVEY.md §3.5: 3 iterations x 64 Q-evaluations per robot
+action at 1-10 Hz) keeps the reference's numpy/predictor contract — each CEM
+iteration is ONE batched predict call, so on TPU the 64 candidate actions
+ride the MXU in a single forward pass; models exposing a traceable batched
+apply can instead run the whole CEM loop on-device via
+``utils.cross_entropy.jax_normal_cem`` (one dispatch per action).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.utils import cross_entropy
+
+
+class Policy(abc.ABC):
+  """Base policy backed by an optional predictor (ref :39)."""
+
+  def __init__(self, predictor=None):
+    self._predictor = predictor
+
+  @abc.abstractmethod
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    """Selects an action for the observed state (ref :47).
+
+    Must not modify ``state`` or ``context``. ``timestep`` is the 0-indexed
+    step within the episode.
+    """
+
+  def reset(self) -> None:
+    """Called at episode boundaries (ref :63)."""
+
+  def init_randomly(self) -> None:
+    if self._predictor is not None:
+      self._predictor.init_randomly()
+
+  def restore(self):
+    """Returns the predictor's success bool (True when nothing to restore)."""
+    if self._predictor is not None:
+      return self._predictor.restore()
+    return True
+
+  @property
+  def model_path(self) -> str:
+    if self._predictor is not None:
+      return self._predictor.model_path
+    return 'No model path defined.'
+
+  @property
+  def global_step(self) -> int:
+    if self._predictor is not None:
+      return self._predictor.global_step
+    return 0
+
+  def sample_action(self, obs, explore_prob):
+    """run_env-compatible adapter (ref :89): returns (action, debug)."""
+    del explore_prob
+    action = self.SelectAction(obs, None, None)
+    return action, None
+
+
+class CEMPolicy(Policy):
+  """CEM argmax over a critic's Q (ref :112).
+
+  Each CEM iteration packs the state with ``cem_samples`` candidate actions
+  and scores them in one predictor call.
+  """
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               cem_iters: int = 3,
+               cem_samples: int = 64,
+               num_elites: int = 10,
+               pack_fn: Optional[Callable] = None,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._cem_iters = cem_iters
+    self._cem_samples = cem_samples
+    self._action_size = action_size
+    self._num_elites = num_elites
+    self.sample_fn = self._default_sample_fn
+    self.pack_fn = pack_fn if pack_fn is not None else self._default_pack_fn
+    self._t2r_model = t2r_model
+
+  def _default_sample_fn(self, mean, stddev):
+    return mean + stddev * np.random.standard_normal(
+        (self._cem_samples, self._action_size))
+
+  def get_cem_action(self, objective_fn):
+    """CEM approximate argmax of ``objective_fn`` (ref :139-172)."""
+
+    def update_fn(params, elite_samples):
+      del params
+      return {
+          'mean': np.mean(elite_samples, axis=0),
+          'stddev': np.std(elite_samples, axis=0, ddof=1),
+      }
+
+    initial_params = {
+        'mean': np.zeros(self._action_size),
+        'stddev': np.ones(self._action_size),
+    }
+    samples, values, final_params = cross_entropy.cross_entropy_method(
+        self.sample_fn, objective_fn, update_fn, initial_params,
+        num_elites=self._num_elites, num_iterations=self._cem_iters)
+    idx = int(np.argmax(values))
+    debug = {'q_predicted': values[idx], 'final_params': final_params,
+             'best_idx': idx}
+    return samples[idx], debug
+
+  def _default_pack_fn(self, t2r_model, state, context, timestep, samples):
+    return t2r_model.pack_features(state, context, timestep, samples)
+
+  def _select_action_with_debug(self, state, context, timestep):
+
+    def objective_fn(samples):
+      np_inputs = self.pack_fn(self._t2r_model, state, context, timestep,
+                               samples)
+      return self._predictor.predict(np_inputs)['q_predicted']
+
+    return self.get_cem_action(objective_fn)
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    action, _ = self._select_action_with_debug(state, context, timestep)
+    return action
+
+  def sample_action(self, obs, explore_prob):
+    """run_env adapter surfacing the elite Q for per-step summaries
+    (run_env.py:205 reads debug['q'])."""
+    del explore_prob
+    action, debug = self._select_action_with_debug(obs, None, None)
+    return action, {'q': debug['q_predicted']}
+
+
+class LSTMCEMPolicy(CEMPolicy):
+  """CEMPolicy caching the critic's LSTM hidden state across steps (ref :194).
+
+  The predictor returns the hidden-state batch for every candidate; after CEM
+  picks the elite action its hidden state becomes next step's carry.
+  """
+
+  def __init__(self, hidden_state_size: int, **kwargs):
+    self._hidden_state_size = hidden_state_size
+    super().__init__(**kwargs)
+    self.reset()
+
+  def reset(self) -> None:
+    self._hidden_state = np.zeros((self._hidden_state_size,), np.float32)
+
+  def _select_action_with_debug(self, state, context, timestep):
+    del context  # the hidden state takes the context slot in pack_fn
+
+    def objective_fn(samples):
+      np_inputs = self.pack_fn(self._t2r_model, state, self._hidden_state,
+                               timestep, samples)
+      predictions = self._predictor.predict(np_inputs)
+      self._hidden_state_batch = predictions['lstm_hidden_state']
+      return predictions['q_predicted']
+
+    action, debug = self.get_cem_action(objective_fn)
+    self._hidden_state = self._hidden_state_batch[debug['best_idx']]
+    return action, debug
+
+
+class RegressionPolicy(Policy):
+  """Direct action regression (ref :228)."""
+
+  def __init__(self, t2r_model, **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    return self._predictor.predict(np_inputs)['inference_output'][0]
+
+
+class SequentialRegressionPolicy(RegressionPolicy):
+  """Feeds the previous packed input back as context (ref :246)."""
+
+  def reset(self) -> None:
+    self._sequence_context = None
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    np_inputs = self._t2r_model.pack_features(
+        state, self._sequence_context, timestep)
+    self._sequence_context = np_inputs
+    return self._predictor.predict(np_inputs)['inference_output'][0]
+
+
+class OUExploreRegressionPolicy(Policy):
+  """Regression + Ornstein-Uhlenbeck exploration noise (ref :264)."""
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               theta: float = 0.2,
+               sigma: float = 0.15,
+               use_noise: bool = True,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self.theta, self.sigma, self.mu = theta, sigma, 0.0
+    self._action_size = action_size
+    self._x_t = np.zeros(action_size)
+    self._use_noise = use_noise
+
+  def ou_step(self):
+    dx_t = (self.theta * (self.mu - self._x_t) +
+            self.sigma * np.random.randn(*self._x_t.shape))
+    self._x_t = self._x_t + dx_t
+    return self._x_t
+
+  def reset(self) -> None:
+    self._x_t = np.zeros(self._action_size)
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    action = self._predictor.predict(np_inputs)['inference_output'][0]
+    noise = self.ou_step() if self._use_noise else 0
+    return action + noise
+
+
+class ScheduledExplorationRegressionPolicy(Policy):
+  """Gaussian noise with a linear stddev schedule over global step (ref :301)."""
+
+  def __init__(self,
+               t2r_model,
+               action_size: int = 2,
+               stddev_0: float = 0.2,
+               slope: float = 0.0,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self._action_size = action_size
+    self._stddev_0 = stddev_0
+    self._slope = slope
+
+  def get_noise(self):
+    stddev = max(self._stddev_0 + self.global_step * self._slope, 0)
+    return stddev * np.random.randn(self._action_size)
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    np_inputs = self._t2r_model.pack_features(state, context, timestep)
+    action = self._predictor.predict(np_inputs)['inference_output'][0]
+    return action + self.get_noise()
+
+
+class PerEpisodeSwitchPolicy(Policy):
+  """Picks an explore or greedy sub-policy once per episode (ref :330)."""
+
+  def __init__(self, explore_policy_class, greedy_policy_class,
+               explore_prob: float, **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._explore_policy = explore_policy_class()
+    self._greedy_policy = greedy_policy_class()
+    self._explore_prob = explore_prob
+    self._active_policy = None
+
+  def reset(self) -> None:
+    self._explore_policy.reset()
+    self._greedy_policy.reset()
+    if np.random.random() < self._explore_prob:
+      self._active_policy = self._explore_policy
+    else:
+      self._active_policy = self._greedy_policy
+
+  def init_randomly(self) -> None:
+    self._explore_policy.init_randomly()
+    self._greedy_policy.init_randomly()
+
+  def restore(self) -> None:
+    self._explore_policy.restore()
+    self._greedy_policy.restore()
+
+  @property
+  def global_step(self) -> int:
+    """The greedy policy's step (ref :364)."""
+    return self._greedy_policy.global_step
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    if self._active_policy is None:
+      self.reset()
+    return self._active_policy.SelectAction(state, context, timestep)

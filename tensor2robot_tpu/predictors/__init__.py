@@ -1,0 +1,15 @@
+"""Predictors: load trained models and expose numpy predict functions."""
+
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.predictors.checkpoint_predictor import (
+    CheckpointPredictor,
+)
+from tensor2robot_tpu.predictors.exported_model_predictor import (
+    ExportedModelPredictor,
+)
+
+__all__ = [
+    'AbstractPredictor',
+    'CheckpointPredictor',
+    'ExportedModelPredictor',
+]

@@ -1,0 +1,68 @@
+"""AbstractPredictor: numpy-in / numpy-out model serving interface.
+
+Parity target: /root/reference/predictors/abstract_predictor.py:32-87. The
+contract robot-side code programs against: ``predict(features_dict)``,
+spec getters, ``restore``/``init_randomly``/``close``, and version metadata.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class AbstractPredictor(abc.ABC):
+  """Loads a model and exposes a predict function (ref :32)."""
+
+  @abc.abstractmethod
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Runs the model on a dict of feature arrays (ref :40)."""
+
+  @abc.abstractmethod
+  def get_feature_specification(self):
+    """The input features required for prediction (ref :51)."""
+
+  def get_label_specification(self):
+    """Optional labels for evaluation of the model (ref :54)."""
+    return None
+
+  @abc.abstractmethod
+  def restore(self) -> bool:
+    """Restores parameters from the latest available data (ref :60).
+
+    Returns True on success (the reference raises/loops; a bool lets the
+    collect loop decide whether to keep polling).
+    """
+
+  def init_randomly(self) -> None:
+    """Initializes parameters randomly, for tests and cold starts (ref :63)."""
+
+  @abc.abstractmethod
+  def close(self) -> None:
+    """Releases all handles (ref :67)."""
+
+  def assert_is_loaded(self) -> None:
+    """Raises ValueError if restore/init has not happened yet (ref :71)."""
+    if not self.is_loaded:
+      raise ValueError('The predictor has not been restored yet.')
+
+  @property
+  def is_loaded(self) -> bool:
+    return False
+
+  @property
+  def model_version(self) -> int:
+    """The version of the model currently in use (ref :75)."""
+    return 0
+
+  @property
+  def global_step(self) -> int:
+    """The global step of the model currently in use (ref :80)."""
+    return 0
+
+  @property
+  def model_path(self) -> str:
+    """The path of the model currently in use (ref :85)."""
+    return ''

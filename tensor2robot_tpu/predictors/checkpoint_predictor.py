@@ -1,0 +1,121 @@
+"""CheckpointPredictor: serve straight from training checkpoints.
+
+Parity target: /root/reference/predictors/checkpoint_predictor.py:39-212.
+The reference rebuilds the PREDICT graph from the T2RModel in its own
+tf.Graph with placeholders (:69-102), busy-waits for checkpoints (:134-179),
+and serves via session.run (:106-117). Here the model's pure predict step is
+jitted once; ``restore`` polls the Orbax checkpoint directory and swaps the
+variables pytree — no graph rebuild, no session.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.export.export_generators import make_serve_fn
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.specs import generators as spec_generators
+from tensor2robot_tpu.trainer import checkpointing
+
+_POLL_INTERVAL_SECS = 1.0
+
+
+class CheckpointPredictor(AbstractPredictor):
+  """Polls <checkpoint_dir>/checkpoints and serves the newest step."""
+
+  def __init__(self,
+               t2r_model,
+               checkpoint_dir: Optional[str] = None,
+               timeout: float = 600.0):
+    """Args:
+      t2r_model: the model whose predict path to serve.
+      checkpoint_dir: the trainer's model_dir. None => init_randomly only
+        (ref checkpoint_predictor.py:47 allows checkpoint-less predictors).
+      timeout: max seconds restore() busy-waits for a first checkpoint
+        (ref :47 — 600s default).
+    """
+    self._model = t2r_model
+    self._checkpoint_dir = checkpoint_dir
+    self._timeout = timeout
+    self._variables = None
+    self._restored_step: Optional[int] = None
+    # The one shared serving path (preprocess + predict_step), jitted once.
+    self._serve_fn = jax.jit(make_serve_fn(t2r_model))
+
+  # -- loading ---------------------------------------------------------------
+
+  def init_randomly(self) -> None:
+    """ref :121 — random init from the model's specs, no checkpoint."""
+    feature_spec = self._model.get_feature_specification_for_packing(
+        ModeKeys.PREDICT)
+    features = spec_generators.make_random_numpy(feature_spec, batch_size=1)
+    self._variables = self._model.init_variables(
+        jax.random.PRNGKey(0), features, None, ModeKeys.PREDICT)
+    self._restored_step = 0
+
+  def restore(self) -> bool:
+    """Busy-waits for a (new) checkpoint, then loads it (ref :134-179)."""
+    if self._checkpoint_dir is None:
+      raise ValueError('CheckpointPredictor constructed without a '
+                       'checkpoint_dir; call init_randomly() instead.')
+    deadline = time.time() + self._timeout
+    while True:
+      step = checkpointing.latest_checkpoint_step(self._checkpoint_dir)
+      if step is not None and step != self._restored_step:
+        break
+      if self._restored_step is not None and step == self._restored_step:
+        return True  # nothing newer; current state is still valid
+      if time.time() > deadline:
+        return False
+      time.sleep(_POLL_INTERVAL_SECS)
+    manager = checkpointing.CheckpointManager(self._checkpoint_dir,
+                                              async_checkpoints=False)
+    try:
+      restored = manager.restore(None, step=step)
+    finally:
+      manager.close()
+    variables = {'params': restored['params'],
+                 **(restored.get('model_state') or {})}
+    if restored.get('avg_params') is not None:
+      variables['avg_params'] = restored['avg_params']
+    self._variables = variables
+    self._restored_step = step
+    return True
+
+  # -- serving ---------------------------------------------------------------
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    self.assert_is_loaded()
+    outputs = self._serve_fn(self._variables, dict(features))
+    return {k: np.asarray(v) for k, v in jax.device_get(outputs).items()}
+
+  def get_feature_specification(self):
+    return self._model.preprocessor.get_in_feature_specification(
+        ModeKeys.PREDICT)
+
+  def get_label_specification(self):
+    return self._model.get_label_specification(ModeKeys.PREDICT)
+
+  @property
+  def is_loaded(self) -> bool:
+    return self._variables is not None
+
+  @property
+  def global_step(self) -> int:
+    return self._restored_step or 0
+
+  @property
+  def model_version(self) -> int:
+    return self._restored_step or 0
+
+  @property
+  def model_path(self) -> str:
+    return self._checkpoint_dir or ''
+
+  def close(self) -> None:
+    self._variables = None

@@ -1,0 +1,6 @@
+"""RL collect/eval: run policies in environments, write replay TFRecords."""
+
+from tensor2robot_tpu.rl.run_env import run_env
+from tensor2robot_tpu.rl.collect_eval import collect_eval_loop
+
+__all__ = ['collect_eval_loop', 'run_env']

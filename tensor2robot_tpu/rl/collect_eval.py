@@ -1,0 +1,97 @@
+"""Continuous collect/eval loop: the robot-side half of distributed RL.
+
+Parity target: /root/reference/utils/continuous_collect_eval.py:32-113.
+Polls the policy's predictor for new weights (exported by the trainer's
+hooks), runs collect + eval episodes, and writes replay TFRecords — the
+filesystem actor↔learner transport of SURVEY.md §2.9.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from tensor2robot_tpu.rl import run_env as run_env_lib
+
+_POLL_SLEEP_SECS = 10
+
+
+def collect_eval_loop(collect_env,
+                      eval_env,
+                      policy_class: Callable,
+                      num_collect: int = 2000,
+                      num_eval: int = 100,
+                      run_agent_fn: Optional[Callable] = None,
+                      root_dir: str = '',
+                      continuous: bool = False,
+                      min_collect_eval_step: int = 0,
+                      max_steps: int = 1,
+                      pre_collect_eval_fn: Optional[Callable] = None,
+                      record_eval_env_video: bool = False,
+                      init_with_random_variables: bool = False,
+                      poll_sleep_secs: float = _POLL_SLEEP_SECS,
+                      max_poll_attempts: Optional[int] = None) -> None:
+  """Collect/eval a policy against live envs (ref collect_eval_loop :32).
+
+  Args:
+    collect_env: env to collect training data from (None disables collect).
+    eval_env: env to evaluate on (None disables eval).
+    policy_class: zero-arg factory for the policy.
+    num_collect: collect episodes per policy version.
+    num_eval: eval episodes per policy version.
+    run_agent_fn: override for run_env.run_env.
+    root_dir: base dir; data lands in policy_collect/ and eval/.
+    continuous: keep polling for newer policies until step > max_steps.
+    min_collect_eval_step: skip policy versions below this step.
+    max_steps: stop once the policy's step exceeds this (continuous mode).
+    pre_collect_eval_fn: runs once before the loop (e.g. replay seeding).
+    record_eval_env_video: route env video output per policy version.
+    init_with_random_variables: random-init instead of restore (tests).
+    poll_sleep_secs / max_poll_attempts: waiting knobs (the reference
+      hardcodes 10s sleeps and polls forever; tests need bounds).
+  """
+  if pre_collect_eval_fn:
+    pre_collect_eval_fn()
+  run_agent_fn = run_agent_fn or run_env_lib.run_env
+
+  collect_dir = os.path.join(root_dir, 'policy_collect')
+  eval_dir = os.path.join(root_dir, 'eval')
+
+  policy = policy_class()
+  prev_global_step = -1
+  attempts = 0
+  while True:
+    restored = True
+    if init_with_random_variables:
+      policy.init_randomly()
+    else:
+      restored = policy.restore()
+    global_step = policy.global_step
+
+    # restored is False when the predictor timed out with nothing to load —
+    # running episodes would hit an unloaded predictor, so keep polling.
+    if (restored is False or global_step is None
+        or global_step < min_collect_eval_step
+        or global_step <= prev_global_step):
+      attempts += 1
+      if max_poll_attempts is not None and attempts >= max_poll_attempts:
+        return
+      time.sleep(poll_sleep_secs)
+      continue
+    attempts = 0
+
+    if collect_env:
+      run_agent_fn(collect_env, policy=policy, num_episodes=num_collect,
+                   root_dir=collect_dir, global_step=global_step,
+                   tag='collect')
+    if eval_env:
+      if record_eval_env_video and hasattr(eval_env, 'set_video_output_dir'):
+        eval_env.set_video_output_dir(
+            os.path.join(root_dir, 'videos', str(global_step)))
+      run_agent_fn(eval_env, policy=policy, num_episodes=num_eval,
+                   root_dir=eval_dir, global_step=global_step, tag='eval')
+    if not continuous or global_step >= max_steps:
+      return
+
+    prev_global_step = global_step

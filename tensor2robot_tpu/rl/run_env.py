@@ -1,0 +1,120 @@
+"""Step a policy through a Gym-style environment, logging + collecting data.
+
+Parity target: /root/reference/research/dql_grasping_lib/run_env.py:82-239
+(_run_env): episode loop with explore-schedule interpolation, per-step
+(obs, action, reward, next_obs, done, debug) tuples handed to
+``episode_to_transitions_fn`` and a replay writer, episode-reward metrics.
+
+Metrics land in a ``metrics-<tag>.jsonl`` file under ``root_dir`` instead of
+TF summary events; each line is {'step': global_step, 'tag': ..., 'values':
+{...}} — greppable, and loadable by any dashboard.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import json
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+_log_fn = None
+
+
+def _log(msg: str, *args) -> None:
+  global _log_fn
+  if _log_fn is None:
+    from absl import logging
+    _log_fn = logging.info
+  _log_fn(msg, *args)
+
+
+def _write_metrics(root_dir: str, tag: str, global_step: int,
+                   values: dict) -> None:
+  os.makedirs(root_dir, exist_ok=True)
+  path = os.path.join(root_dir, 'metrics-{}.jsonl'.format(tag))
+  with open(path, 'a') as f:
+    f.write(json.dumps({'step': int(global_step), 'tag': tag,
+                        'values': values}) + '\n')
+
+
+def run_env(env,
+            policy=None,
+            explore_schedule=None,
+            episode_to_transitions_fn: Optional[Callable] = None,
+            replay_writer=None,
+            root_dir: Optional[str] = None,
+            task: int = 0,
+            global_step: int = 0,
+            num_episodes: int = 100,
+            tag: str = 'collect',
+            close_env: bool = True) -> list:
+  """Runs the policy for ``num_episodes`` episodes (ref run_env :82).
+
+  Args:
+    env: Gym-style env: ``reset() -> obs``, ``step(a) -> (obs, r, done, dbg)``.
+    policy: object with ``reset()`` and ``sample_action(obs, explore_prob)``.
+    explore_schedule: optional object with ``value(global_step) -> prob``.
+    episode_to_transitions_fn: episode tuples -> serialized records.
+    replay_writer: optional TFRecordReplayWriter for the transitions.
+    root_dir: experiment root; records go to ``policy_<tag>/gs<step>_...``.
+    task: replica index; metrics written only for task 0 (ref :186).
+    global_step: policy checkpoint step (stamps records + metrics).
+    num_episodes: episodes to run.
+    tag: 'collect' | 'eval' prefix.
+    close_env: close the env at the end (ref closes unconditionally :224).
+
+  Returns:
+    The per-episode rewards.
+  """
+  episode_rewards = []
+  episode_q_values = collections.defaultdict(list)
+
+  record_prefix = None
+  if root_dir and replay_writer:
+    timestamp = datetime.datetime.now().strftime('%Y-%m-%d-%H-%M-%S')
+    record_prefix = os.path.join(
+        root_dir, 'policy_{}'.format(tag),
+        'gs{}_t{}_{}'.format(global_step, task, timestamp))
+    os.makedirs(os.path.dirname(record_prefix), exist_ok=True)
+    replay_writer.open(record_prefix)
+
+  try:
+    for ep in range(num_episodes):
+      done, env_step, episode_reward, episode_data = False, 0, 0.0, []
+      policy.reset()
+      obs = env.reset()
+      explore_prob = (explore_schedule.value(global_step)
+                      if explore_schedule else 0)
+      while not done:
+        action, policy_debug = policy.sample_action(obs, explore_prob)
+        if policy_debug and 'q' in policy_debug:
+          episode_q_values[env_step].append(policy_debug['q'])
+        new_obs, rew, done, env_debug = env.step(action)
+        env_step += 1
+        episode_reward += rew
+        episode_data.append((obs, action, rew, new_obs, done, env_debug))
+        obs = new_obs
+        if done:
+          _log('Episode %d reward: %f', ep, episode_reward)
+          episode_rewards.append(episode_reward)
+          if replay_writer and episode_to_transitions_fn:
+            replay_writer.write(episode_to_transitions_fn(episode_data))
+      if episode_rewards and len(episode_rewards) % 10 == 0:
+        _log('Average %d episodes reward: %f', len(episode_rewards),
+             np.mean(episode_rewards))
+  finally:
+    if close_env:
+      env.close()
+    if replay_writer and record_prefix:
+      replay_writer.close()
+
+  if root_dir and task == 0 and episode_rewards:
+    values = {'episode_reward': float(np.mean(episode_rewards))}
+    for step, q_values in episode_q_values.items():
+      values['Q/{}'.format(step)] = float(np.mean(q_values))
+    _write_metrics(os.path.join(root_dir, 'live_eval_{}'.format(task)),
+                   tag, global_step, values)
+  return episode_rewards

@@ -117,11 +117,9 @@ def latest_checkpoint_step(model_dir: str) -> Optional[int]:
   directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
   if not os.path.isdir(directory):
     return None
-  steps = []
-  for name in os.listdir(directory):
-    if name.isdigit() and not name.startswith('tmp'):
-      # Orbax commits atomically by renaming; a bare numeric dir is live.
-      steps.append(int(name))
+  # Orbax commits atomically by renaming; a bare numeric dir is live
+  # (in-flight saves have an .orbax-checkpoint-tmp suffix and fail isdigit).
+  steps = [int(name) for name in os.listdir(directory) if name.isdigit()]
   return max(steps) if steps else None
 
 

@@ -124,6 +124,12 @@ class Trainer:
                                                sharding=s),
           abstract_state, self._state_sharding)
       return self.checkpoint_manager.restore(template, step=latest)
+    if getattr(self.model, 'warm_start_fn', None) is not None:
+      # Warm start restores a foreign checkpoint (real I/O): run it eagerly
+      # exactly once and shard the result, instead of tracing it under jit
+      # where the restored weights would be baked in as XLA constants.
+      state = self.model.create_train_state(rng, features, labels)
+      return jax.device_put(state, self._state_sharding)
     init_fn = jax.jit(
         lambda f, l: self.model.create_train_state(rng, f, l),
         out_shardings=self._state_sharding)
@@ -226,7 +232,8 @@ class Trainer:
     while step_i < max_train_steps:
       features, labels = batch
       device_batch = sharding_lib.shard_batch(
-          {'features': features.to_dict(), 'labels': labels.to_dict()},
+          {'features': features.to_dict(),
+           'labels': labels.to_dict() if labels is not None else None},
           self.mesh)
       state, metrics = step_fn(state, device_batch['features'],
                                device_batch['labels'], base_rng)
@@ -277,7 +284,8 @@ class Trainer:
       features, labels = batch
       batch = None
       device_batch = sharding_lib.shard_batch(
-          {'features': features.to_dict(), 'labels': labels.to_dict()},
+          {'features': features.to_dict(),
+           'labels': labels.to_dict() if labels is not None else None},
           self.mesh)
       metrics = jax.device_get(
           eval_fn(state, device_batch['features'], device_batch['labels']))
@@ -349,7 +357,7 @@ def train_eval_model(t2r_model: AbstractT2RModel,
     # Host pipeline feeds bf16 directly (ref TPUPreprocessorWrapper).
     preprocessor = t2r_model.preprocessor
     if not isinstance(preprocessor, Bfloat16PreprocessorWrapper):
-      t2r_model._preprocessor = Bfloat16PreprocessorWrapper(preprocessor)
+      t2r_model.set_preprocessor(Bfloat16PreprocessorWrapper(preprocessor))
 
   trainer = Trainer(
       t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp, seed=seed,

@@ -73,7 +73,10 @@ def get_subsample_indices(sequence_lengths: jnp.ndarray,
       jitter = jitter.at[0].set(0.0).at[-1].set(0.0)
       base = base + jitter
     idx = jnp.clip(jnp.round(base).astype(jnp.int32), 0, length - 1)
-    return idx
+    # Short episodes: match the numpy variant exactly — keep every frame,
+    # pad by repeating the last one (not a rounded resample).
+    short = jnp.minimum(jnp.arange(sequence_length), length - 1)
+    return jnp.where(length <= sequence_length, short, idx)
 
   if rng is None:
     return jax.vmap(lambda l: one(l, None))(sequence_lengths)

@@ -1,0 +1,125 @@
+"""Tests for export generators and exporters (ref export_generators/*_test.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export import (
+    BestModelExporter,
+    DefaultExportGenerator,
+    LatestModelExporter,
+    list_exported_versions,
+    load_exported_variables,
+    write_serving_artifact,
+)
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs import assets as assets_lib
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+from tensor2robot_tpu.trainer import Trainer
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+def _specs():
+  feature_spec = SpecStruct(x=TensorSpec((3,), np.float32, name='x'))
+  label_spec = SpecStruct(y=TensorSpec((1,), np.float32, name='y'))
+  return feature_spec, label_spec
+
+
+def test_write_serving_artifact_roundtrip(tmp_path):
+  root = str(tmp_path / 'export')
+  variables = {'params': {'w': np.arange(6, dtype=np.float32).reshape(2, 3)}}
+  feature_spec, label_spec = _specs()
+  path = write_serving_artifact(root, variables, feature_spec, label_spec,
+                                global_step=42)
+  assert list_exported_versions(root) == [int(os.path.basename(path))]
+  # assets contract: pbtxt + json + global step file all present.
+  assets_file = os.path.join(path, assets_lib.EXTRA_ASSETS_DIRECTORY,
+                             assets_lib.T2R_ASSETS_FILENAME)
+  fs, ls, step = assets_lib.load_t2r_assets_from_file(assets_file)
+  assert step == 42
+  assert list(fs) == ['x'] and list(ls) == ['y']
+  assert assets_lib.load_global_step_from_file(path) == 42
+  restored = load_exported_variables(path)
+  np.testing.assert_array_equal(restored['params']['w'],
+                                variables['params']['w'])
+
+
+def test_versions_monotonic_and_tmp_filtered(tmp_path):
+  root = str(tmp_path / 'export')
+  variables = {'params': {'w': np.zeros(2, np.float32)}}
+  feature_spec, label_spec = _specs()
+  p1 = write_serving_artifact(root, variables, feature_spec, label_spec, 1)
+  p2 = write_serving_artifact(root, variables, feature_spec, label_spec, 2)
+  assert int(os.path.basename(p2)) > int(os.path.basename(p1))
+  # tmp- dirs (partial writes) must be invisible to pollers.
+  os.makedirs(os.path.join(root, 'tmp-999999999999'))
+  assert 999999999999 not in list_exported_versions(root)
+
+
+@pytest.fixture(scope='module')
+def trained():
+  import tempfile
+  tmp = tempfile.mkdtemp()
+  model = MockT2RModel()
+  generator = MockInputGenerator(batch_size=16)
+  trainer = Trainer(model, tmp, async_checkpoints=False,
+                    save_checkpoints_steps=10**9)
+  state = trainer.train(generator, max_train_steps=2)
+  yield trainer, state
+  trainer.close()
+
+
+def test_default_export_generator(trained, tmp_path):
+  trainer, state = trained
+  generator = DefaultExportGenerator()
+  generator.set_specification_from_model(trainer.model)
+  import jax
+  variables = jax.device_get(state.variables())
+  root = str(tmp_path / 'gen')
+  path = generator.export(root, variables, global_step=2)
+  assert os.path.isdir(os.path.join(path, 'variables'))
+  fs, _, step = assets_lib.load_t2r_assets_from_file(
+      os.path.join(path, assets_lib.EXTRA_ASSETS_DIRECTORY,
+                   assets_lib.T2R_ASSETS_FILENAME))
+  assert step == 2
+  assert 'measured_position' in dict(fs)
+  # warmup requests bundled (ref abstract_export_generator.py:114).
+  warmup = np.load(os.path.join(path, 'warmup_requests.npz'))
+  assert warmup['measured_position'].shape == (1, 8)
+
+
+def test_latest_exporter_retention(trained):
+  trainer, state = trained
+  exporter = LatestModelExporter(exports_to_keep=2)
+  paths = [exporter.export(trainer, state, {'loss': 1.0}) for _ in range(3)]
+  assert all(p is not None for p in paths)
+  root = exporter.export_root(trainer)
+  versions = list_exported_versions(root)
+  assert len(versions) == 2
+  assert str(versions[-1]) == os.path.basename(paths[-1])
+
+
+def test_raw_receivers_flag_recorded(trained, tmp_path):
+  from tensor2robot_tpu.export.export_generators import (
+      AbstractExportGenerator, load_serving_config)
+  trainer, state = trained
+  import jax
+  variables = jax.device_get(state.variables())
+  for raw in (False, True):
+    generator = AbstractExportGenerator(export_raw_receivers=raw)
+    generator.set_specification_from_model(trainer.model)
+    root = str(tmp_path / ('raw' if raw else 'cooked'))
+    path = generator.export(root, variables, global_step=1)
+    assert load_serving_config(path)['raw_receivers'] is raw
+
+
+def test_best_exporter_only_improvements(trained):
+  trainer, state = trained
+  exporter = BestModelExporter()
+  assert exporter.export(trainer, state, {'loss': 1.0}) is not None
+  assert exporter.export(trainer, state, {'loss': 2.0}) is None  # worse
+  assert exporter.export(trainer, state, {}) is None             # missing key
+  assert exporter.export(trainer, state, {'loss': 0.5}) is not None
+  assert len(list_exported_versions(exporter.export_root(trainer))) == 2

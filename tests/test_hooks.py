@@ -1,0 +1,111 @@
+"""Hook tests: export-during-training, lagged TD3 dirs, variable logging.
+
+Mirrors /root/reference/hooks/*_test.py: train through the real harness and
+assert the filesystem contracts (exports appear, lagged dir trails by one,
+GC bounds versions).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.export import list_exported_versions
+from tensor2robot_tpu.hooks import (
+    AsyncExportHookBuilder,
+    CheckpointExportHook,
+    LaggedCheckpointExportHook,
+    TD3Hooks,
+    VariableLoggerHook,
+)
+from tensor2robot_tpu.predictors import ExportedModelPredictor
+from tensor2robot_tpu.trainer import Trainer, train_eval_model
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+def _train(tmp, hooks=(), steps=4):
+  model = MockT2RModel()
+  trainer = Trainer(model, tmp, async_checkpoints=False,
+                    save_checkpoints_steps=10**9)
+  state = trainer.train(MockInputGenerator(batch_size=8), steps, hooks=hooks)
+  trainer.close()
+  return state
+
+
+def test_checkpoint_export_hook_exports_periodically(tmp_path):
+  export_dir = str(tmp_path / 'export')
+  hook = CheckpointExportHook(export_dir, export_every_steps=2,
+                              exports_to_keep=5)
+  _train(str(tmp_path / 'run'), hooks=[hook], steps=4)
+  # Exports at steps 2, 4 (end-of-train dedupes with step 4).
+  assert len(list_exported_versions(export_dir)) == 2
+  predictor = ExportedModelPredictor(export_dir, t2r_model=MockT2RModel(),
+                                     timeout=5.0)
+  assert predictor.restore()
+  assert predictor.global_step == 4
+  predictor.close()
+
+
+def test_checkpoint_export_hook_gc(tmp_path):
+  export_dir = str(tmp_path / 'export')
+  hook = CheckpointExportHook(export_dir, export_every_steps=1,
+                              exports_to_keep=2)
+  _train(str(tmp_path / 'run'), hooks=[hook], steps=5)
+  assert len(list_exported_versions(export_dir)) == 2
+
+
+def test_lagged_export_hook_trails_by_one(tmp_path):
+  export_dir = str(tmp_path / 'latest')
+  lagged_dir = str(tmp_path / 'lagged')
+  hook = LaggedCheckpointExportHook(export_dir, lagged_dir,
+                                    export_every_steps=2, exports_to_keep=10)
+  _train(str(tmp_path / 'run'), hooks=[hook], steps=6)
+  latest = list_exported_versions(export_dir)
+  lagged = list_exported_versions(lagged_dir)
+  assert len(latest) == 3
+  # The one-version-behind invariant: the lagged (TD3 target) dir must
+  # NEVER contain the newest live version — not even after end() dedupe.
+  assert latest[-1] not in lagged
+  assert lagged[-1] == latest[-2]
+  # Both dirs are loadable artifacts.
+  for root in (export_dir, lagged_dir):
+    predictor = ExportedModelPredictor(root, t2r_model=MockT2RModel(),
+                                       timeout=5.0)
+    assert predictor.restore()
+    predictor.close()
+
+
+def test_td3_hook_builder(tmp_path):
+  builder = TD3Hooks(save_steps=2)
+  model = MockT2RModel()
+  trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                    save_checkpoints_steps=10**9)
+  hooks = builder.create_hooks(model, trainer)
+  assert len(hooks) == 1
+  trainer.train(MockInputGenerator(batch_size=8), 4, hooks=hooks)
+  trainer.close()
+  assert list_exported_versions(hooks[0].export_dir)
+  assert list_exported_versions(hooks[0].lagged_export_dir)
+
+
+def test_async_export_hook_builder_in_train_eval(tmp_path):
+  model = MockT2RModel()
+  result = train_eval_model(
+      model, str(tmp_path),
+      input_generator_train=MockInputGenerator(batch_size=8),
+      max_train_steps=4,
+      train_hook_builders=[AsyncExportHookBuilder(save_steps=2)],
+      async_checkpoints=False, save_checkpoints_steps=10**9)
+  assert result['state'] is not None
+  export_dir = os.path.join(str(tmp_path), 'export', 'latest_exporter')
+  assert list_exported_versions(export_dir)
+
+
+def test_variable_logger_hook(tmp_path, caplog):
+  import logging
+  hook = VariableLoggerHook(log_every_n_steps=1, log_values=True)
+  with caplog.at_level(logging.INFO):
+    _train(str(tmp_path / 'run'), hooks=[hook], steps=2)
+  # absl routes into the python logging root; assert we logged variables.
+  assert any('var ' in r.message for r in caplog.records)

@@ -1,0 +1,197 @@
+"""Policy tests (ref policies are exercised via research-model tests; here
+the CEM/regression/exploration behaviors are tested against fake predictors)."""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.policies import (
+    CEMPolicy,
+    LSTMCEMPolicy,
+    OUExploreRegressionPolicy,
+    PerEpisodeSwitchPolicy,
+    Policy,
+    RegressionPolicy,
+    ScheduledExplorationRegressionPolicy,
+    SequentialRegressionPolicy,
+)
+
+TARGET = np.asarray([0.3, -0.4])
+
+
+class _QuadraticQPredictor:
+  """Q(s, a) = -||a - TARGET||^2 — CEM should find TARGET."""
+
+  def __init__(self):
+    self.restored = 0
+    self.global_step = 11
+    self.model_path = '/fake'
+
+  def predict(self, np_inputs):
+    actions = np_inputs['action']
+    q = -np.sum((actions - TARGET) ** 2, axis=-1)
+    return {'q_predicted': q, 'lstm_hidden_state': actions.copy()}
+
+  def restore(self):
+    self.restored += 1
+    return True
+
+  def init_randomly(self):
+    pass
+
+
+def _pack_actions(model, state, context, timestep, samples):
+  del model, state, context, timestep
+  return {'action': np.asarray(samples)}
+
+
+class _FakeRegressionModel:
+
+  def pack_features(self, state, context, timestep):
+    return {'state': np.asarray([state], np.float32)}
+
+
+class _ConstantActionPredictor:
+
+  def __init__(self, action):
+    self._action = np.asarray(action)
+    self.global_step = 5
+    self.model_path = '/fake'
+
+  def predict(self, np_inputs):
+    batch = 1
+    for v in np_inputs.values():
+      batch = np.shape(v)[0]
+      break
+    return {'inference_output': np.tile(self._action, (batch, 1))}
+
+  def restore(self):
+    return True
+
+  def init_randomly(self):
+    pass
+
+
+def test_cem_policy_finds_quadratic_max():
+  np.random.seed(0)
+  policy = CEMPolicy(t2r_model=None, action_size=2, cem_iters=10,
+                     cem_samples=256, num_elites=16, pack_fn=_pack_actions,
+                     predictor=_QuadraticQPredictor())
+  action = policy.SelectAction(None, None, 0)
+  np.testing.assert_allclose(action, TARGET, atol=0.1)
+  assert policy.global_step == 11
+  assert policy.model_path == '/fake'
+
+
+def test_cem_sample_action_surfaces_q_debug():
+  # run_env reads debug['q'] for per-step Q summaries (run_env.py).
+  np.random.seed(1)
+  policy = CEMPolicy(t2r_model=None, action_size=2, cem_iters=2,
+                     cem_samples=32, num_elites=8, pack_fn=_pack_actions,
+                     predictor=_QuadraticQPredictor())
+  action, debug = policy.sample_action(None, explore_prob=0.0)
+  assert action.shape == (2,)
+  assert 'q' in debug and np.isscalar(float(debug['q']))
+
+
+def test_policy_restore_propagates_predictor_bool():
+
+  class _FailingPredictor(_QuadraticQPredictor):
+
+    def restore(self):
+      return False
+
+  policy = CEMPolicy(t2r_model=None, action_size=2, pack_fn=_pack_actions,
+                     predictor=_FailingPredictor())
+  assert policy.restore() is False
+  assert Policy.restore(CEMPolicy(t2r_model=None, pack_fn=_pack_actions,
+                                  predictor=None)) is True
+
+
+def test_lstm_cem_policy_caches_hidden_state():
+  np.random.seed(0)
+  policy = LSTMCEMPolicy(hidden_state_size=2, t2r_model=None, action_size=2,
+                         cem_iters=3, cem_samples=64, num_elites=8,
+                         pack_fn=_pack_actions,
+                         predictor=_QuadraticQPredictor())
+  np.testing.assert_array_equal(policy._hidden_state, np.zeros(2))
+  action = policy.SelectAction(None, None, 0)
+  # The cached hidden state is the best sample's (predictor echoes actions).
+  np.testing.assert_array_equal(policy._hidden_state, action)
+  policy.reset()
+  np.testing.assert_array_equal(policy._hidden_state, np.zeros(2))
+
+
+def test_regression_policy():
+  policy = RegressionPolicy(
+      t2r_model=_FakeRegressionModel(),
+      predictor=_ConstantActionPredictor([1.0, 2.0]))
+  action = policy.SelectAction(0.5, None, 0)
+  np.testing.assert_array_equal(action, [1.0, 2.0])
+
+
+def test_sequential_regression_policy_carries_context():
+  model_calls = []
+
+  class _Model:
+
+    def pack_features(self, state, context, timestep):
+      model_calls.append(context)
+      return {'state': np.asarray([[state]], np.float32)}
+
+  policy = SequentialRegressionPolicy(
+      t2r_model=_Model(), predictor=_ConstantActionPredictor([0.0]))
+  policy.reset()
+  policy.SelectAction(1.0, None, 0)
+  policy.SelectAction(2.0, None, 1)
+  assert model_calls[0] is None
+  assert model_calls[1] is not None  # previous packed input fed back
+
+
+def test_ou_explore_policy_noise_stateful():
+  np.random.seed(3)
+  policy = OUExploreRegressionPolicy(
+      t2r_model=_FakeRegressionModel(), action_size=2,
+      predictor=_ConstantActionPredictor([0.0, 0.0]))
+  a1 = policy.SelectAction(0.1, None, 0)
+  a2 = policy.SelectAction(0.1, None, 1)
+  assert not np.allclose(a1, a2)  # the OU process moves
+  policy.reset()
+  np.testing.assert_array_equal(policy._x_t, np.zeros(2))
+  policy._use_noise = False
+  np.testing.assert_array_equal(policy.SelectAction(0.1, None, 2), [0.0, 0.0])
+
+
+def test_scheduled_exploration_policy_slope():
+  np.random.seed(4)
+  predictor = _ConstantActionPredictor([0.0, 0.0])
+  policy = ScheduledExplorationRegressionPolicy(
+      t2r_model=_FakeRegressionModel(), action_size=2, stddev_0=1.0,
+      slope=-1.0, predictor=predictor)
+  # global_step=5, slope=-1 => stddev = max(1 - 5, 0) = 0: no noise at all.
+  np.testing.assert_array_equal(policy.SelectAction(0.1, None, 0), [0.0, 0.0])
+
+
+def test_per_episode_switch_policy():
+
+  class _Marker(Policy):
+
+    def __init__(self, tag):
+      super().__init__()
+      self.tag = tag
+
+    def SelectAction(self, state, context, timestep):
+      return self.tag
+
+  np.random.seed(0)
+  policy = PerEpisodeSwitchPolicy(lambda: _Marker('explore'),
+                                  lambda: _Marker('greedy'),
+                                  explore_prob=0.5)
+  seen = set()
+  for _ in range(20):
+    policy.reset()
+    seen.add(policy.SelectAction(None, None, 0))
+  assert seen == {'explore', 'greedy'}
+  # Within an episode the choice is stable.
+  policy.reset()
+  tags = {policy.SelectAction(None, None, t) for t in range(5)}
+  assert len(tags) == 1

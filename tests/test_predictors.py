@@ -1,0 +1,152 @@
+"""Predictor tests: checkpoint + exported-artifact serving paths.
+
+Mirrors /root/reference/predictors/*_test.py: restore, predict, version
+metadata, and train-vs-serve numeric parity (the reference asserts serving
+predictions match Estimator predictions, utils/train_eval_test.py:91+).
+"""
+
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data import wire
+from tensor2robot_tpu.export import DefaultExportGenerator
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.predictors import (
+    CheckpointPredictor,
+    ExportedModelPredictor,
+)
+from tensor2robot_tpu.trainer import Trainer
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+
+@pytest.fixture(scope='module')
+def trained():
+  tmp = tempfile.mkdtemp()
+  model = MockT2RModel()
+  generator = MockInputGenerator(batch_size=16)
+  trainer = Trainer(model, tmp, async_checkpoints=False,
+                    save_checkpoints_steps=10**9)
+  state = trainer.train(generator, max_train_steps=3)
+  features, _ = next(generator.create_dataset_iterator(mode=ModeKeys.TRAIN))
+  yield trainer, state, features
+  trainer.close()
+
+
+def test_checkpoint_predictor_restores_and_predicts(trained):
+  trainer, state, features = trained
+  predictor = CheckpointPredictor(MockT2RModel(), trainer.model_dir,
+                                  timeout=5.0)
+  with pytest.raises(ValueError):
+    predictor.assert_is_loaded()
+  assert predictor.restore()
+  assert predictor.global_step == 3
+  outputs = predictor.predict(features.to_dict())
+  assert outputs['logits'].shape == (16, 1)
+  # Train-vs-serve parity: same params, same features, same logits.
+  expected = trainer.predict(state, features)
+  np.testing.assert_allclose(outputs['logits'], expected['logits'],
+                             rtol=1e-5, atol=1e-5)
+  # A second restore with no newer checkpoint keeps serving (no deadlock).
+  assert predictor.restore()
+  predictor.close()
+
+
+def test_checkpoint_predictor_init_randomly(trained):
+  _, _, features = trained
+  predictor = CheckpointPredictor(MockT2RModel(), checkpoint_dir=None)
+  predictor.init_randomly()
+  outputs = predictor.predict(features.to_dict())
+  assert outputs['logits'].shape == (16, 1)
+  assert predictor.global_step == 0
+
+
+def test_checkpoint_predictor_timeout(tmp_path):
+  predictor = CheckpointPredictor(MockT2RModel(), str(tmp_path), timeout=0.1)
+  assert not predictor.restore()
+
+
+@pytest.fixture(scope='module')
+def exported(trained):
+  trainer, state, features = trained
+  generator = DefaultExportGenerator()
+  generator.set_specification_from_model(trainer.model)
+  variables = jax.device_get(state.variables())
+  root = tempfile.mkdtemp()
+  generator.export(root, variables, global_step=3, batch_size=16)
+  return root, features
+
+
+def test_exported_predictor_with_model(exported, trained):
+  trainer, state, _ = trained
+  root, features = exported
+  predictor = ExportedModelPredictor(root, t2r_model=MockT2RModel(),
+                                     timeout=5.0)
+  assert predictor.restore()
+  assert predictor.global_step == 3
+  assert predictor.model_version > 0
+  spec = predictor.get_feature_specification()
+  assert 'measured_position' in dict(spec)
+  outputs = predictor.predict(features.to_dict())
+  expected = trainer.predict(state, features)
+  np.testing.assert_allclose(outputs['logits'], expected['logits'],
+                             rtol=1e-5, atol=1e-5)
+  predictor.close()
+
+
+def test_exported_predictor_without_model_code(exported, trained):
+  """The StableHLO artifact serves with ZERO Python model code, at ANY
+  batch size (symbolic batch dim — the None-placeholder equivalent)."""
+  trainer, state, _ = trained
+  root, features = exported
+  predictor = ExportedModelPredictor(root, t2r_model=None, timeout=5.0)
+  assert predictor.restore()
+  outputs = predictor.predict(features.to_dict())
+  expected = trainer.predict(state, features)
+  np.testing.assert_allclose(outputs['logits'], expected['logits'],
+                             rtol=1e-5, atol=1e-5)
+  # Different batch size than the export warmup batch (16).
+  small = {k: v[:5] for k, v in features.to_dict().items()}
+  assert predictor.predict(small)['logits'].shape == (5, 1)
+  predictor.close()
+
+
+def test_exported_predictor_serialized_receiver(exported):
+  """tf.Example-style receiver: serialized records in, predictions out."""
+  root, features = exported
+  predictor = ExportedModelPredictor(root, t2r_model=MockT2RModel(),
+                                     timeout=5.0)
+  assert predictor.restore()
+  records = [
+      wire.build_example(
+          {'measured_position': features['measured_position'][i]})
+      for i in range(16)
+  ]
+  outputs = predictor.predict_serialized(records)
+  direct = predictor.predict(features.to_dict())
+  np.testing.assert_allclose(outputs['logits'], direct['logits'],
+                             rtol=1e-5, atol=1e-5)
+  predictor.close()
+
+
+def test_exported_predictor_timeout_on_empty_dir(tmp_path):
+  predictor = ExportedModelPredictor(str(tmp_path), t2r_model=MockT2RModel(),
+                                     timeout=0.1)
+  assert not predictor.restore()
+
+
+def test_exported_predictor_picks_newest_and_survives_gc(exported, trained):
+  trainer, state, features = trained
+  root, _ = exported
+  generator = DefaultExportGenerator()
+  generator.set_specification_from_model(trainer.model)
+  variables = jax.device_get(state.variables())
+  generator.export(root, variables, global_step=7, batch_size=16)
+  predictor = ExportedModelPredictor(root, t2r_model=MockT2RModel(),
+                                     timeout=5.0)
+  assert predictor.restore()
+  assert predictor.global_step == 7
+  predictor.close()

@@ -1,0 +1,155 @@
+"""Collect/eval loop tests (ref continuous_collect_eval + run_env behavior)."""
+
+import json
+import os
+
+import numpy as np
+
+from tensor2robot_tpu.data import wire
+from tensor2robot_tpu.data.tfrecord import read_all_records
+from tensor2robot_tpu.data.writer import TFRecordReplayWriter
+from tensor2robot_tpu.rl import collect_eval_loop, run_env
+
+
+class _CountdownEnv:
+  """Episode ends after 3 steps; reward equals the action."""
+
+  def __init__(self):
+    self.closed = False
+    self._t = 0
+
+  def reset(self):
+    self._t = 0
+    return np.float32(self._t)
+
+  def step(self, action):
+    self._t += 1
+    done = self._t >= 3
+    return np.float32(self._t), float(action), done, {}
+
+  def close(self):
+    self.closed = True
+
+
+class _ConstPolicy:
+
+  def __init__(self, action=1.0, step=7):
+    self.resets = 0
+    self._action = action
+    self.global_step = step
+    self.restores = 0
+
+  def reset(self):
+    self.resets += 1
+
+  def restore(self):
+    self.restores += 1
+    self.global_step += 1
+
+  def init_randomly(self):
+    pass
+
+  def sample_action(self, obs, explore_prob):
+    return self._action, {'q': 0.5}
+
+
+def _episode_to_transitions(episode_data):
+  return [wire.build_example({'reward': np.asarray([r], np.float32)})
+          for (_, _, r, _, _, _) in episode_data]
+
+
+def test_run_env_episodes_and_metrics(tmp_path):
+  env = _CountdownEnv()
+  policy = _ConstPolicy()
+  rewards = run_env(env, policy=policy, num_episodes=4,
+                    root_dir=str(tmp_path), global_step=7, tag='eval')
+  assert rewards == [3.0] * 4
+  assert policy.resets == 4
+  assert env.closed
+  metrics_path = os.path.join(str(tmp_path), 'live_eval_0',
+                              'metrics-eval.jsonl')
+  with open(metrics_path) as f:
+    record = json.loads(f.readline())
+  assert record['step'] == 7
+  assert record['values']['episode_reward'] == 3.0
+  assert 'Q/0' in record['values']
+
+
+def test_run_env_writes_replay_records(tmp_path):
+  env = _CountdownEnv()
+  rewards = run_env(env, policy=_ConstPolicy(), num_episodes=2,
+                    episode_to_transitions_fn=_episode_to_transitions,
+                    replay_writer=TFRecordReplayWriter(),
+                    root_dir=str(tmp_path), global_step=3, tag='collect')
+  assert len(rewards) == 2
+  record_dir = os.path.join(str(tmp_path), 'policy_collect')
+  files = os.listdir(record_dir)
+  assert len(files) == 1 and files[0].startswith('gs3_t0_')
+  records = read_all_records(os.path.join(record_dir, files[0]))
+  assert len(records) == 6  # 2 episodes x 3 steps
+  parsed = wire.parse_example(records[0])
+  assert 'reward' in parsed
+
+
+def test_collect_eval_loop_single_pass(tmp_path):
+  calls = []
+
+  def run_agent_fn(env, policy, num_episodes, root_dir, global_step, tag):
+    calls.append((tag, num_episodes, global_step, root_dir))
+
+  collect_eval_loop(
+      collect_env=_CountdownEnv(), eval_env=_CountdownEnv(),
+      policy_class=_ConstPolicy, num_collect=5, num_eval=2,
+      run_agent_fn=run_agent_fn, root_dir=str(tmp_path), continuous=False)
+  assert [c[0] for c in calls] == ['collect', 'eval']
+  assert calls[0][1] == 5 and calls[1][1] == 2
+  assert calls[0][3].endswith('policy_collect')
+  assert calls[1][3].endswith('eval')
+
+
+def test_collect_eval_loop_continuous_stops_at_max_steps(tmp_path):
+  steps_seen = []
+
+  def run_agent_fn(env, policy, num_episodes, root_dir, global_step, tag):
+    if tag == 'collect':
+      steps_seen.append(global_step)
+
+  collect_eval_loop(
+      collect_env=_CountdownEnv(), eval_env=None,
+      policy_class=lambda: _ConstPolicy(step=0),
+      num_collect=1, run_agent_fn=run_agent_fn, root_dir=str(tmp_path),
+      continuous=True, max_steps=3, poll_sleep_secs=0.01)
+  # restore() bumps step each poll: 1, 2, 3 then stop.
+  assert steps_seen == [1, 2, 3]
+
+
+def test_collect_eval_loop_skips_when_restore_fails(tmp_path):
+  # Regression: a predictor timing out (restore() -> False) must keep
+  # polling, never run episodes with unloaded weights.
+
+  class _NeverReadyPolicy(_ConstPolicy):
+
+    def restore(self):
+      self.restores += 1
+      return False
+
+  def run_agent_fn(env, policy, num_episodes, root_dir, global_step, tag):
+    raise AssertionError('must not run with an unrestored policy')
+
+  collect_eval_loop(
+      collect_env=_CountdownEnv(), eval_env=None,
+      policy_class=_NeverReadyPolicy, num_collect=1,
+      run_agent_fn=run_agent_fn, root_dir=str(tmp_path),
+      poll_sleep_secs=0.01, max_poll_attempts=3)
+
+
+def test_collect_eval_loop_min_step_gate(tmp_path):
+
+  def run_agent_fn(env, policy, num_episodes, root_dir, global_step, tag):
+    raise AssertionError('should never run below min_collect_eval_step')
+
+  collect_eval_loop(
+      collect_env=_CountdownEnv(), eval_env=None,
+      policy_class=lambda: _ConstPolicy(step=0), num_collect=1,
+      run_agent_fn=run_agent_fn, root_dir=str(tmp_path),
+      min_collect_eval_step=100, poll_sleep_secs=0.01, max_poll_attempts=3)

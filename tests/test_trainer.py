@@ -49,6 +49,49 @@ class TestTrainer:
                                state=state)
     assert metrics['loss'] < 0.7
 
+  def test_train_without_labels(self, model_dir):
+    # Regression: label-free (self-supervised-style) generators yield
+    # (features, None); the loop must not assume labels exist.
+    from tensor2robot_tpu.data.input_generators import GeneratorInputGenerator
+    from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+    from tensor2robot_tpu.specs.struct import SpecStruct
+    from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class _Net(nn.Module):
+
+      @nn.compact
+      def __call__(self, features, mode='train', train=False):
+        return {'recon': nn.Dense(4)(features['x'])}
+
+    class _SelfSupModel(AbstractT2RModel):
+
+      def __init__(self):
+        super().__init__(device_type='cpu')
+
+      def get_feature_specification(self, mode):
+        return SpecStruct(x=TensorSpec((4,), np.float32, name='x'))
+
+      def get_label_specification(self, mode):
+        return SpecStruct()
+
+      def create_network(self):
+        return _Net()
+
+      def model_train_fn(self, variables, features, labels, outputs, mode):
+        return jnp.mean((outputs['recon'] - features['x']) ** 2), SpecStruct()
+
+    generator = GeneratorInputGenerator(
+        batch_generator_fn=lambda n: SpecStruct(
+            x=np.random.rand(n, 4).astype(np.float32)),
+        batch_size=8)
+    trainer = Trainer(_SelfSupModel(), model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    state = trainer.train(generator, max_train_steps=2)
+    trainer.close()
+    assert int(jax.device_get(state.step)) == 2
+
   def test_restore_resumes_from_checkpoint(self, model_dir):
     model, generator = _make()
     trainer = Trainer(model, model_dir, save_checkpoints_steps=10,

@@ -103,6 +103,15 @@ class TestSubsample:
     assert idx[0, 0] == 0 and idx[0, -1] == 29
     assert np.all(idx >= 0) and np.all(idx < 30)
 
+  def test_jax_matches_numpy_on_short_episodes(self):
+    # Regression: the jitted variant must pad short episodes exactly like
+    # the host-side numpy variant (repeat the last frame), not resample.
+    for length in (1, 2, 3, 4, 5):
+      np_idx = subsample.get_subsample_indices_numpy(np.array([length]), 5)
+      jx_idx = np.asarray(
+          subsample.get_subsample_indices(jnp.asarray([length]), 5))
+      np.testing.assert_array_equal(np_idx, jx_idx)
+
   def test_subsample_sequence_gather(self):
     data = np.arange(2 * 10 * 3).reshape(2, 10, 3)
     idx = np.array([[0, 5, 9], [1, 2, 3]])
