@@ -88,6 +88,17 @@ class LaggedCheckpointExportHook(CheckpointExportHook):
   def lagged_export_dir(self) -> str:
     return self._lagged_export_dir
 
+  def _mirror_version(self, version_dir: str) -> None:
+    """Atomically copies one version dir into the lagged dir (idempotent)."""
+    version = os.path.basename(version_dir)
+    target = os.path.join(self._lagged_export_dir, version)
+    if os.path.isdir(target):
+      return
+    os.makedirs(self._lagged_export_dir, exist_ok=True)
+    tmp = os.path.join(self._lagged_export_dir, 'tmp-' + version)
+    shutil.copytree(version_dir, tmp)
+    os.rename(tmp, target)  # atomic: pollers never see partials
+
   def _export(self, trainer, state):
     step = int(jax.device_get(state.step))
     if step == self._last_exported_step:
@@ -96,22 +107,12 @@ class LaggedCheckpointExportHook(CheckpointExportHook):
       return None
     latest = export_generators.list_exported_versions(self._export_dir)
     if latest:
-      newest = str(latest[-1])
-      lagged_target = os.path.join(self._lagged_export_dir, newest)
-      if not os.path.isdir(lagged_target):
-        os.makedirs(self._lagged_export_dir, exist_ok=True)
-        tmp = os.path.join(self._lagged_export_dir, 'tmp-' + newest)
-        shutil.copytree(os.path.join(self._export_dir, newest), tmp)
-        os.rename(tmp, lagged_target)  # atomic: pollers never see partials
-        _gc_versions(self._lagged_export_dir, self._exports_to_keep)
+      self._mirror_version(os.path.join(self._export_dir, str(latest[-1])))
+      _gc_versions(self._lagged_export_dir, self._exports_to_keep)
     path = super()._export(trainer, state)
     if path is not None and not export_generators.list_exported_versions(
         self._lagged_export_dir):
       # First export ever: seed the lagged dir so TD3 actors can start
       # immediately (ref :96 initial-copy behavior).
-      newest = os.path.basename(path)
-      tmp = os.path.join(self._lagged_export_dir, 'tmp-' + newest)
-      os.makedirs(self._lagged_export_dir, exist_ok=True)
-      shutil.copytree(path, tmp)
-      os.rename(tmp, os.path.join(self._lagged_export_dir, newest))
+      self._mirror_version(path)
     return path

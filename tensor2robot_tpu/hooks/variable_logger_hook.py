@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import numpy as np
+from absl import logging
 
 from tensor2robot_tpu.hooks.hook_builder import TrainHook
 
@@ -21,13 +22,7 @@ class VariableLoggerHook(TrainHook):
     self._log_every_n_steps = log_every_n_steps
     self._log_values = log_values
     self._max_num_variable_values = max_num_variable_values
-    self._log_fn = None
-
-  def _log(self, msg, *args):
-    if self._log_fn is None:
-      from absl import logging
-      self._log_fn = logging.info
-    self._log_fn(msg, *args)
+    self._log = logging.info
 
   def after_step(self, trainer, state, step: int, metrics) -> None:
     if step % self._log_every_n_steps != 0:

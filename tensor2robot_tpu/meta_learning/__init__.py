@@ -1,0 +1,37 @@
+"""Meta-learning: MAML models, preprocessors, task-batched data utilities."""
+
+from tensor2robot_tpu.meta_learning.maml_inner_loop import (
+    MAMLInnerLoopGradientDescent,
+)
+from tensor2robot_tpu.meta_learning.maml_model import (
+    MAMLModel,
+    MAMLRegressionModel,
+)
+from tensor2robot_tpu.meta_learning.preprocessors import (
+    MAMLPreprocessorV2,
+    create_maml_feature_spec,
+    create_maml_label_spec,
+)
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.meta_learning.meta_policies import (
+    MAMLCEMPolicy,
+    MAMLRegressionPolicy,
+    MetaLearningPolicy,
+    ScheduledExplorationMAMLRegressionPolicy,
+)
+from tensor2robot_tpu.meta_learning.run_meta_env import run_meta_env
+
+__all__ = [
+    'MAMLCEMPolicy',
+    'MAMLInnerLoopGradientDescent',
+    'MAMLModel',
+    'MAMLPreprocessorV2',
+    'MAMLRegressionModel',
+    'MAMLRegressionPolicy',
+    'MetaLearningPolicy',
+    'ScheduledExplorationMAMLRegressionPolicy',
+    'create_maml_feature_spec',
+    'create_maml_label_spec',
+    'meta_data',
+    'run_meta_env',
+]

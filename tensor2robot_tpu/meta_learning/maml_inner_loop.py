@@ -1,0 +1,149 @@
+"""MAML inner loop: functional gradient-descent adaptation over a params pytree.
+
+Parity target: /root/reference/meta_learning/maml_inner_loop.py:33-333
+(MAMLInnerLoopGradientDescent). The reference intercepts tf.get_variable via
+a custom getter and substitutes ``var - lr * grad`` tensors on each of the k
+adaptation steps, with a first/second-order switch (stop_gradient, :190) and
+optional per-variable learned inner learning rates (:88-100).
+
+In JAX the 900 lines of getter machinery reduce to ``jax.grad`` over the
+params pytree and a tree-map SGD update; ``jax.grad`` through the whole
+inner loop gives exact second-order MAML, and stop_gradient on the update
+recovers the first-order variant. The loop is vmapped over tasks by
+MAMLModel and differentiated again by the outer optimizer — all one XLA
+program on TPU (no tf.map_fn / while_loop restrictions on batch norm or
+summaries).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _path_str(path) -> str:
+  parts = []
+  for entry in path:
+    parts.append(str(getattr(entry, 'key', getattr(entry, 'idx', entry))))
+  return '/'.join(parts)
+
+
+class MAMLInnerLoopGradientDescent:
+  """Configurable inner-loop SGD (ref :33)."""
+
+  def __init__(self,
+               learning_rate: float = 0.001,
+               use_second_order: bool = True,
+               var_scope: Optional[str] = None,
+               learn_inner_lr: bool = False):
+    """Args mirror the reference (:56-79).
+
+    Args:
+      learning_rate: inner SGD step size (the init value when
+        ``learn_inner_lr``).
+      use_second_order: backprop through the inner gradients; False
+        stop-gradients the update (first-order MAML).
+      var_scope: '/'-joined params-path prefix; only matching leaves adapt
+        in the inner loop (the outer loop still trains everything).
+      learn_inner_lr: learn one inner LR per parameter leaf, trained by the
+        outer loop.
+    """
+    self._learning_rate = learning_rate
+    self._use_second_order = use_second_order
+    self._var_scope = var_scope
+    self._learn_inner_lr = learn_inner_lr
+
+  @property
+  def learn_inner_lr(self) -> bool:
+    return self._learn_inner_lr
+
+  def create_inner_lr_params(self, params) -> Any:
+    """Per-leaf learned LRs initialized at ``learning_rate`` (ref :88-100)."""
+    return jax.tree.map(
+        lambda _: jnp.asarray(self._learning_rate, jnp.float32), params)
+
+  def _adapt(self, params, grads, inner_lrs):
+    """One SGD step over the pytree, honoring var_scope + order switch."""
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    adapt_mask = {
+        _path_str(path): (self._var_scope is None or
+                          _path_str(path).startswith(self._var_scope))
+        for path, _ in flat_params
+    }
+
+    def _step(path, value, grad, lr):
+      if not adapt_mask[_path_str(path)]:
+        return value
+      update = (lr if lr is not None else self._learning_rate) * grad
+      if not self._use_second_order:
+        update = jax.lax.stop_gradient(update)
+      return value - update
+
+    if inner_lrs is None:
+      return jax.tree_util.tree_map_with_path(
+          lambda path, v, g: _step(path, v, g, None), params, grads)
+    return jax.tree_util.tree_map_with_path(_step, params, grads, inner_lrs)
+
+  def inner_loop(self,
+                 params,
+                 model_state,
+                 inputs_list: Sequence[Tuple[Any, Any]],
+                 inference_network_fn: Callable,
+                 model_train_fn: Callable,
+                 mode: str,
+                 inner_lrs=None,
+                 rng=None):
+    """k adaptation steps + conditioned/unconditioned val passes (ref :218).
+
+    Args:
+      params: the base model's params pytree (adapted copies are derived).
+      model_state: non-param collections, held fixed through adaptation.
+      inputs_list: ((cond_f, cond_l),) * k + ((val_f, val_l),) — one
+        gradient step per entry except the last (ref :235).
+      inference_network_fn / model_train_fn: the base model's pure fns.
+      mode: ModeKeys value forwarded to the base model.
+      inner_lrs: optional per-leaf learned LR pytree.
+      rng: optional dropout rng for the base forward passes.
+
+    Returns:
+      ([unconditioned_outputs, conditioned_outputs], inner_outputs,
+       inner_losses) exactly as the reference (:332): inner_outputs has
+       k+1 entries (the extra final forward monitors adaptation), and
+       inner_losses the matching k+1 scalars.
+    """
+
+    def forward(p, features, labels):
+      variables = {'params': p, **(model_state or {})}
+      outputs, _ = inference_network_fn(variables, features, labels, mode,
+                                        rng)
+      return outputs
+
+    def loss_fn(p, features, labels):
+      variables = {'params': p, **(model_state or {})}
+      outputs = forward(p, features, labels)
+      loss, _ = model_train_fn(variables, features, labels, outputs, mode)
+      return loss, outputs
+
+    current = params
+    inner_outputs: List[Any] = []
+    inner_losses: List[jnp.ndarray] = []
+    for features, labels in inputs_list[:-1]:
+      (loss, outputs), grads = jax.value_and_grad(
+          loss_fn, has_aux=True)(current, features, labels)
+      inner_outputs.append(outputs)
+      inner_losses.append(loss)
+      current = self._adapt(current, grads, inner_lrs)
+
+    # One more conditioned forward + loss on the last condition batch to
+    # monitor whether adaptation helped (ref :294-312) — no gradient step.
+    final_features, final_labels = inputs_list[-2]
+    final_loss, final_outputs = loss_fn(current, final_features, final_labels)
+    inner_outputs.append(final_outputs)
+    inner_losses.append(final_loss)
+
+    val_features, val_labels = inputs_list[-1]
+    conditioned = forward(current, val_features, val_labels)
+    unconditioned = forward(params, val_features, val_labels)
+    return [unconditioned, conditioned], inner_outputs, inner_losses

@@ -1,0 +1,217 @@
+"""MAMLModel: wraps any base T2RModel for gradient-based meta-learning.
+
+Parity target: /root/reference/meta_learning/maml_model.py:76-554. The
+reference vectorizes the per-task inner loop with tf.map_fn (inferring
+output dtypes by building the base model in a throwaway graph, :154-189);
+here the per-task adaptation is a pure function ``vmap``ped over the task
+dim — dtypes are free, batch norm works, and the outer ``jax.grad``
+differentiates straight through (second-order MAML) as one XLA program.
+
+Predictions layout matches the reference (:327-359):
+  full_condition_outputs/output_<i>/<k>  per-inner-step outputs (k+1 entries)
+  full_condition_output/<k>              == output_0 (pre-adaptation)
+  full_inference_output/<k>              post-adaptation val outputs
+  full_inference_output_unconditioned/<k>
+  inner_losses/step_<i>                  mean inner loss per step
+plus 'condition_output'/'inference_output' assigned by
+``_select_inference_output``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.meta_learning import preprocessors as meta_preprocessors
+from tensor2robot_tpu.meta_learning.maml_inner_loop import (
+    MAMLInnerLoopGradientDescent,
+)
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+INNER_LRS_KEY = 'maml_inner_lrs'
+
+
+def _sub_dict(struct, prefix: str) -> dict:
+  out = {}
+  for key in struct:
+    if key.startswith(prefix):
+      out[key[len(prefix):]] = struct[key]
+  return out
+
+
+class MAMLModel(AbstractT2RModel):
+  """Base class for MAML-style meta models (ref :76)."""
+
+  def __init__(self,
+               base_model: AbstractT2RModel,
+               preprocessor_cls=None,
+               num_inner_loop_steps: int = 1,
+               var_scope: Optional[str] = None,
+               inner_loop: Optional[MAMLInnerLoopGradientDescent] = None,
+               **kwargs):
+    """Args mirror the reference (:79-103); ``use_parallel_for`` is gone —
+    vmap is always the vectorization."""
+    kwargs.setdefault('device_type', base_model.device_type)
+    super().__init__(**kwargs)
+    self._base_model = base_model
+    self._maml_preprocessor_cls = preprocessor_cls
+    self._num_inner_loop_steps = max(int(num_inner_loop_steps), 1)
+    self._var_scope = var_scope
+    self._inner_loop = inner_loop or MAMLInnerLoopGradientDescent(
+        var_scope=var_scope)
+
+  @property
+  def base_model(self) -> AbstractT2RModel:
+    return self._base_model
+
+  # -- specs / preprocessor --------------------------------------------------
+
+  @property
+  def preprocessor(self):
+    if self._preprocessor is None:
+      cls = self._maml_preprocessor_cls or meta_preprocessors.MAMLPreprocessorV2
+      self._preprocessor = cls(self._base_model.preprocessor)
+    return self._preprocessor
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return meta_preprocessors.create_maml_feature_spec(
+        self._base_model.get_feature_specification(mode),
+        self._base_model.get_label_specification(mode))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return meta_preprocessors.create_maml_label_spec(
+        self._base_model.get_label_specification(mode))
+
+  # -- state -----------------------------------------------------------------
+
+  def init_variables(self, rng, features, labels=None, mode: str = 'train'):
+    """Initializes the BASE model on one task's condition batch."""
+    cond_features = SpecStruct(
+        **{k: v[0] for k, v in
+           _sub_dict(features, 'condition/features/').items()})
+    cond_labels = SpecStruct(
+        **{k: v[0] for k, v in
+           _sub_dict(features, 'condition/labels/').items()})
+    variables = self._base_model.init_variables(rng, cond_features,
+                                                cond_labels, mode)
+    if self._inner_loop.learn_inner_lr:
+      variables['params'] = {
+          'base': variables['params'],
+          INNER_LRS_KEY: self._inner_loop.create_inner_lr_params(
+              variables['params']),
+      }
+    return variables
+
+  def _split_params(self, params):
+    if self._inner_loop.learn_inner_lr:
+      return params['base'], params[INNER_LRS_KEY]
+    return params, None
+
+  # -- forward ---------------------------------------------------------------
+
+  def inference_network_fn(self, variables, features, labels=None,
+                           mode: str = 'train', rng=None):
+    base_params, inner_lrs = self._split_params(variables['params'])
+    model_state = {k: v for k, v in variables.items() if k != 'params'}
+
+    cond_f = _sub_dict(features, 'condition/features/')
+    cond_l = _sub_dict(features, 'condition/labels/')
+    inf_f = _sub_dict(features, 'inference/features/')
+    # The inner loop never uses the val labels; condition labels stand in
+    # when the outer labels are absent (predict mode, ref :298-300).
+    val_l = dict(labels) if labels is not None and len(labels) else cond_l
+
+    def task_learn(task_cond_f, task_cond_l, task_inf_f, task_val_l):
+      inputs_list = ([(SpecStruct(**task_cond_f), SpecStruct(**task_cond_l))]
+                     * self._num_inner_loop_steps +
+                     [(SpecStruct(**task_inf_f), SpecStruct(**task_val_l))])
+      return self._inner_loop.inner_loop(
+          base_params, model_state, inputs_list,
+          self._base_model.inference_network_fn,
+          self._base_model.model_train_fn, mode, inner_lrs=inner_lrs,
+          rng=rng)
+
+    (outputs, inner_outputs, inner_losses) = jax.vmap(task_learn)(
+        cond_f, cond_l, inf_f, val_l)
+    unconditioned, conditioned = outputs
+
+    predictions = SpecStruct()
+    for pos, step_outputs in enumerate(inner_outputs):
+      for key in step_outputs:
+        predictions['full_condition_outputs/output_{}/{}'.format(
+            pos, key)] = step_outputs[key]
+    for key in inner_outputs[0]:
+      predictions['full_condition_output/' + key] = inner_outputs[0][key]
+    for key in conditioned:
+      predictions['full_inference_output/' + key] = conditioned[key]
+    for key in unconditioned:
+      predictions['full_inference_output_unconditioned/' + key] = (
+          unconditioned[key])
+    for pos, loss in enumerate(inner_losses):
+      predictions['inner_losses/step_{}'.format(pos)] = jnp.mean(loss)
+
+    predictions = self._select_inference_output(predictions)
+    if 'condition_output' not in predictions:
+      raise ValueError('_select_inference_output must assign '
+                       'condition_output.')
+    if 'inference_output' not in predictions:
+      raise ValueError('_select_inference_output must assign '
+                       'inference_output.')
+    return predictions, None
+
+  @abc.abstractmethod
+  def _select_inference_output(self, predictions: SpecStruct) -> SpecStruct:
+    """Assigns condition_output + inference_output (ref :361)."""
+
+  # -- losses ----------------------------------------------------------------
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """Outer loss: base loss on flattened post-adaptation outputs (ref :420)."""
+    inf_features = meta_data.flatten_batch_examples(
+        SpecStruct(**_sub_dict(features, 'inference/features/')))
+    inf_outputs = meta_data.flatten_batch_examples(
+        SpecStruct(**_sub_dict(inference_outputs, 'full_inference_output/')))
+    labels_flat = meta_data.flatten_batch_examples(SpecStruct(**dict(labels)))
+    base_variables = dict(variables)
+    base_variables['params'], _ = self._split_params(variables['params'])
+    loss, train_outputs = self._base_model.model_train_fn(
+        base_variables, inf_features, labels_flat, inf_outputs, mode)
+    outputs = SpecStruct(**dict(train_outputs or {}))
+    for key in inference_outputs:
+      if key.startswith('inner_losses/'):
+        outputs[key.replace('/', '_')] = inference_outputs[key]
+    return loss, outputs
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    """ref :503 — base eval metrics on the flattened inference outputs."""
+    inf_features = meta_data.flatten_batch_examples(
+        SpecStruct(**_sub_dict(features, 'inference/features/')))
+    inf_outputs = meta_data.flatten_batch_examples(
+        SpecStruct(**_sub_dict(inference_outputs, 'full_inference_output/')))
+    labels_flat = meta_data.flatten_batch_examples(SpecStruct(**dict(labels)))
+    base_variables = dict(variables)
+    base_variables['params'], _ = self._split_params(variables['params'])
+    return self._base_model.model_eval_fn(
+        base_variables, inf_features, labels_flat, inf_outputs, mode)
+
+
+class MAMLRegressionModel(MAMLModel):
+  """MAML over any regression-style base model: selects 'inference_output'
+  (the concrete class of e.g. PoseEnvRegressionModelMAML, ref
+  research/pose_env/pose_env_maml_models.py:47-54)."""
+
+  output_key = 'inference_output'
+
+  def _select_inference_output(self, predictions: SpecStruct) -> SpecStruct:
+    predictions['condition_output'] = predictions[
+        'full_condition_output/' + self.output_key]
+    predictions['inference_output'] = predictions[
+        'full_inference_output/' + self.output_key]
+    return predictions

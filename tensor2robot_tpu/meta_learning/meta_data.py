@@ -1,0 +1,227 @@
+"""Task-batched data utilities for meta-learning.
+
+Parity target: /root/reference/meta_learning/meta_tfdata.py: flatten/
+unflatten of the [num_tasks, num_samples] leading dims (:179, :206),
+``multi_batch_apply`` (:266), and the one-file-per-task reader that batches
+``num_condition + num_inference`` examples per task (:37, :135).
+
+Host-side code is numpy; the flatten/unflatten helpers are dtype-agnostic
+and jit-safe (pure reshapes), used on device by the MAML outer loss.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
+from tensor2robot_tpu.data.parser import ExampleParser
+from tensor2robot_tpu.data.pipeline import parse_file_patterns
+from tensor2robot_tpu.data.tfrecord import read_all_records
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+def flatten_batch_examples(struct):
+  """[num_tasks, num_samples, ...] -> [num_tasks * num_samples, ...] (ref :179)."""
+  def _merge(x):
+    return x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:]))
+  if isinstance(struct, (dict, SpecStruct)):
+    return SpecStruct(**{k: _merge(struct[k]) for k in struct})
+  return _merge(struct)
+
+
+def unflatten_batch_examples(struct, num_samples_per_task: int):
+  """Inverse of flatten_batch_examples (ref :206)."""
+  def _split(x):
+    return x.reshape((-1, num_samples_per_task) + tuple(x.shape[1:]))
+  if isinstance(struct, (dict, SpecStruct)):
+    return SpecStruct(**{k: _split(struct[k]) for k in struct})
+  return _split(struct)
+
+
+def multi_batch_apply(fn: Callable, num_batch_dims: int, *args, **kwargs):
+  """Applies ``fn`` (expecting one batch dim) over several batch dims (ref :266).
+
+  Leading ``num_batch_dims`` dims of every array leaf in args/kwargs are
+  merged, ``fn`` is applied, and its outputs' leading dim is split back.
+  """
+  import jax
+
+  leaves = [leaf for a in (args, kwargs) for leaf in jax.tree_util.tree_leaves(a)
+            if hasattr(leaf, 'shape')]
+  if not leaves:
+    raise ValueError('multi_batch_apply needs at least one array argument.')
+  batch_dims = tuple(leaves[0].shape[:num_batch_dims])
+
+  def _merge(x):
+    if hasattr(x, 'shape') and len(x.shape) >= num_batch_dims:
+      return x.reshape((-1,) + tuple(x.shape[num_batch_dims:]))
+    return x
+
+  def _split(x):
+    if hasattr(x, 'shape'):
+      return x.reshape(batch_dims + tuple(x.shape[1:]))
+    return x
+
+  merged_args = jax.tree.map(_merge, args,
+                             is_leaf=lambda x: hasattr(x, 'shape'))
+  merged_kwargs = jax.tree.map(_merge, kwargs,
+                               is_leaf=lambda x: hasattr(x, 'shape'))
+  outputs = fn(*merged_args, **merged_kwargs)
+  return jax.tree.map(_split, outputs,
+                      is_leaf=lambda x: hasattr(x, 'shape'))
+
+
+def _stack_struct(structs: Sequence[SpecStruct]) -> SpecStruct:
+  out = SpecStruct()
+  for key in structs[0]:
+    out[key] = np.stack([np.asarray(s[key]) for s in structs])
+  return out
+
+
+def split_meta_in_spec(meta_in_spec):
+  """Meta in-spec -> (base feature spec, base label spec).
+
+  Inverts create_maml_feature_spec: drops the meta name prefix (so record
+  parsing maps to the on-disk base names) and the prepended samples dim.
+  """
+  from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+  def _debase(spec):
+    name = spec.name
+    if name and name.startswith(('condition_features/', 'condition_labels/')):
+      name = name.split('/', 1)[1]
+    shape = spec.shape
+    if shape and shape[0] is None:
+      shape = shape[1:]  # the unknown samples dim added by the meta spec
+    return TensorSpec.from_spec(spec, name=name, shape=shape)
+
+  feature_spec, label_spec = SpecStruct(), SpecStruct()
+  for key in meta_in_spec:
+    if key.startswith('condition/features/'):
+      feature_spec[key[len('condition/features/'):]] = _debase(
+          meta_in_spec[key])
+    elif key.startswith('condition/labels/'):
+      label_spec[key[len('condition/labels/'):]] = _debase(meta_in_spec[key])
+  return feature_spec, label_spec
+
+
+def to_meta_batch(features: SpecStruct, labels: SpecStruct,
+                  num_condition: int):
+  """[tasks, samples, ...] base batches -> (meta_features, meta_labels).
+
+  The first ``num_condition`` samples of each task feed the inner loop;
+  the rest feed the outer loss (ref meta_tfdata.split_train_val :135).
+  """
+  meta_features = SpecStruct()
+  for key in features:
+    meta_features['condition/features/' + key] = features[key][:, :num_condition]
+    meta_features['inference/features/' + key] = features[key][:, num_condition:]
+  for key in labels:
+    meta_features['condition/labels/' + key] = labels[key][:, :num_condition]
+  meta_labels = SpecStruct()
+  for key in labels:
+    meta_labels[key] = labels[key][:, num_condition:]
+  return meta_features, meta_labels
+
+
+class MetaRecordInputGenerator(AbstractInputGenerator):
+  """One TFRecord file == one task (ref meta_tfdata.parallel_read :37).
+
+  Each yielded batch groups ``num_tasks`` tasks; per task,
+  ``num_condition_samples_per_task`` examples feed the inner loop and
+  ``num_inference_samples_per_task`` the outer loss. Leaves are shaped
+  [num_tasks, num_samples, ...] and packed into the MAML meta-spec layout
+  (condition/features/..., condition/labels/..., inference/features/...,
+  meta label keys) by the MAMLPreprocessorV2 in-spec this generator is
+  bound to.
+  """
+
+  def __init__(self,
+               file_patterns: str,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1,
+               num_tasks: Optional[int] = None,
+               shuffle: bool = True,
+               **kwargs):
+    kwargs.setdefault('batch_size', num_tasks or 2)
+    super().__init__(**kwargs)
+    self._file_patterns = file_patterns
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+    self._num_tasks = num_tasks or self._batch_size
+    self._shuffle = shuffle
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    _, files = parse_file_patterns(self._file_patterns)
+    if not files:
+      raise ValueError('No task files match {}.'.format(self._file_patterns))
+    feature_spec, label_spec = split_meta_in_spec(self._feature_spec)
+    parser = ExampleParser(feature_spec, label_spec)
+    samples_per_task = self._num_condition + self._num_inference
+    rng = np.random.RandomState(seed)
+
+    def _read_task(path):
+      records = read_all_records(path)
+      if len(records) < samples_per_task:
+        # Small tasks wrap around (sampling with replacement).
+        records = records * ((samples_per_task // len(records)) + 1)
+      idx = (rng.choice(len(records), samples_per_task, replace=False)
+             if self._shuffle else np.arange(samples_per_task))
+      features, labels = parser.parse_batch([records[i] for i in idx])
+      return features, labels
+
+    def _iter():
+      epoch = 0
+      while num_epochs is None or epoch < num_epochs:
+        order = rng.permutation(len(files)) if self._shuffle else np.arange(
+            len(files))
+        for start in range(0, len(order) - self._num_tasks + 1,
+                           self._num_tasks):
+          task_feats, task_labels = [], []
+          for file_idx in order[start:start + self._num_tasks]:
+            features, labels = _read_task(files[file_idx])
+            task_feats.append(features)
+            task_labels.append(labels)
+          features = _stack_struct(task_feats)     # [tasks, samples, ...]
+          labels = _stack_struct(task_labels)
+          yield to_meta_batch(features, labels, self._num_condition)
+        epoch += 1
+
+    return _iter()
+
+
+class MAMLRandomInputGenerator(AbstractInputGenerator):
+  """Spec-conforming random meta-batches — the meta test-data backbone."""
+
+  def __init__(self,
+               num_tasks: int = 2,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1,
+               **kwargs):
+    kwargs.setdefault('batch_size', num_tasks)
+    super().__init__(**kwargs)
+    self._num_tasks = num_tasks
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    feature_spec, label_spec = split_meta_in_spec(self._feature_spec)
+    samples = self._num_condition + self._num_inference
+
+    def _iter():
+      step = 0
+      while num_epochs is None or step < num_epochs:
+        features = unflatten_batch_examples(
+            specs_lib.make_random_numpy(
+                feature_spec, batch_size=self._num_tasks * samples,
+                seed=None if seed is None else seed + step), samples)
+        labels = unflatten_batch_examples(
+            specs_lib.make_random_numpy(
+                label_spec, batch_size=self._num_tasks * samples,
+                seed=None if seed is None else seed + step + 977), samples)
+        yield to_meta_batch(features, labels, self._num_condition)
+        step += 1
+    return _iter()

@@ -1,0 +1,127 @@
+"""Meta-learning policies: feed conditioning demos + inference state.
+
+Parity target: /root/reference/meta_learning/meta_policies.py:32-207.
+A MetaLearningPolicy carries per-task state: ``adapt(episode_data)`` stores
+the conditioning episodes (demos/trials) that ``pack_features`` folds into
+the meta feature layout at every SelectAction; ``reset_task`` clears them.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu.policies import policies
+
+
+class MetaLearningPolicy(policies.Policy, abc.ABC):
+  """Policies that adapt per task from collected episodes (ref :32)."""
+
+  def reset_task(self) -> None:
+    pass
+
+  @abc.abstractmethod
+  def adapt(self, episode_data) -> None:
+    """Stores conditioning episode data for subsequent action selection."""
+
+
+class MAMLRegressionPolicy(MetaLearningPolicy, policies.RegressionPolicy):
+  """Regression policy with gradient-descent fast adaptation (ref :103)."""
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.reset_task()
+
+  def reset_task(self) -> None:
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data) -> None:
+    self._prev_episode_data = episode_data
+
+  def sample_action(self, obs, explore_prob):
+    del explore_prob
+    action = self.SelectAction(obs, None, None)
+    # Replay writers require the is_demo flag when forming meta examples.
+    return action, {'is_demo': False}
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    np_features = self._t2r_model.pack_features(state,
+                                                self._prev_episode_data,
+                                                timestep)
+    action = np.asarray(
+        self._predictor.predict(np_features)['inference_output'])
+    # [task, samples, (T,) action] -> single action (ref :129-137).
+    if action.ndim == 4:
+      return action[0, 0, 0]
+    if action.ndim == 3:
+      return action[0, 0]
+    raise ValueError('Invalid action rank {}.'.format(action.ndim))
+
+
+class MAMLCEMPolicy(MetaLearningPolicy, policies.CEMPolicy):
+  """CEM policy over an adapted critic (ref :45)."""
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.reset_task()
+
+  def reset_task(self) -> None:
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data) -> None:
+    self._prev_episode_data = episode_data
+
+  def _select_action_with_debug(self, state, context, timestep):
+    prediction_key = ('inference_output' if self._prev_episode_data
+                      else 'condition_output')
+
+    def objective_fn(samples):
+      cem_state = np.tile(np.expand_dims(state, 0),
+                          [np.shape(samples)[0]] + [1] * np.ndim(state))
+      np_inputs = self.pack_fn(self._t2r_model, cem_state,
+                               self._prev_episode_data, timestep, samples)
+      q_values = np.asarray(
+          self._predictor.predict(np_inputs)[prediction_key])
+      if not self._prev_episode_data:
+        # Unadapted Q is meaningless for ranking; CEM degenerates to the
+        # prior (ref :94-95 zeroes the values).
+        q_values = q_values * 0
+      return q_values.reshape(np.shape(samples)[0], -1)[:, 0]
+
+    return self.get_cem_action(objective_fn)
+
+
+class ScheduledExplorationMAMLRegressionPolicy(
+    MetaLearningPolicy, policies.ScheduledExplorationRegressionPolicy):
+  """MAMLRegressionPolicy + scheduled gaussian noise (ref :172)."""
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self.reset_task()
+
+  def reset_task(self) -> None:
+    self._prev_episode_data = None
+
+  def adapt(self, episode_data) -> None:
+    self._prev_episode_data = episode_data
+
+  def sample_action(self, obs, explore_prob):
+    del explore_prob
+    return self.SelectAction(obs, None, None), {'is_demo': False}
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    del context
+    np_features = self._t2r_model.pack_features(state,
+                                                self._prev_episode_data,
+                                                timestep)
+    action = np.asarray(
+        self._predictor.predict(np_features)['inference_output'])
+    if action.ndim == 4:
+      action = action[0, 0, 0]
+    elif action.ndim == 3:
+      action = action[0, 0]
+    else:
+      raise ValueError('Invalid action rank {}.'.format(action.ndim))
+    return action + self.get_noise()

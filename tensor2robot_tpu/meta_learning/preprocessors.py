@@ -1,0 +1,121 @@
+"""MAML preprocessor: wraps any base preprocessor's specs into the meta layout.
+
+Parity target: /root/reference/meta_learning/preprocessors.py:39-135
+(create_maml_feature_spec :39, create_maml_label_spec :74, MAMLPreprocessorV2
+:89). The meta layout (flat keys):
+
+  condition/features/<k>   [num_tasks, num_condition_samples, ...]
+  condition/labels/<k>     inner-loop adaptation data
+  inference/features/<k>   [num_tasks, num_inference_samples, ...]
+  <label k>                outer-loss labels (names prefixed 'meta_labels/')
+
+The base preprocessor's transform is applied per sample via
+``multi_batch_apply`` over the [task, sample] leading dims — inside the
+jitted step, so image distortions etc. still run fused on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs.algebra import (
+    copy_tensorspec,
+    flatten_spec_structure,
+)
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+def create_maml_feature_spec(feature_spec, label_spec) -> SpecStruct:
+  """Base feature+label specs -> meta feature spec (ref :39).
+
+  Condition keeps the base names (so record parsing maps 1:1); specs gain a
+  leading unknown samples dim (the reference's batch_size=-1).
+  """
+  meta = SpecStruct()
+  for key, spec in copy_tensorspec(
+      feature_spec, batch_size=-1, prefix='condition_features').items():
+    meta['condition/features/' + key] = spec
+  for key, spec in copy_tensorspec(
+      label_spec, batch_size=-1, prefix='condition_labels').items():
+    meta['condition/labels/' + key] = spec
+  for key, spec in copy_tensorspec(
+      feature_spec, batch_size=-1, prefix='inference_features').items():
+    meta['inference/features/' + key] = spec
+  return meta
+
+
+def create_maml_label_spec(label_spec) -> SpecStruct:
+  """Base label spec -> outer-loss label spec (ref :74)."""
+  return flatten_spec_structure(
+      copy_tensorspec(label_spec, batch_size=-1, prefix='meta_labels'))
+
+
+class MAMLPreprocessorV2(AbstractPreprocessor):
+  """Meta-wrapper around a base preprocessor (ref :89)."""
+
+  def __init__(self, base_preprocessor: AbstractPreprocessor):
+    super().__init__()
+    self._base_preprocessor = base_preprocessor
+
+  @property
+  def base_preprocessor(self) -> AbstractPreprocessor:
+    return self._base_preprocessor
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    return create_maml_feature_spec(
+        self._base_preprocessor.get_in_feature_specification(mode),
+        self._base_preprocessor.get_in_label_specification(mode))
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return create_maml_label_spec(
+        self._base_preprocessor.get_in_label_specification(mode))
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return create_maml_feature_spec(
+        self._base_preprocessor.get_out_feature_specification(mode),
+        self._base_preprocessor.get_out_label_specification(mode))
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return create_maml_label_spec(
+        self._base_preprocessor.get_out_label_specification(mode))
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None
+                     ) -> Tuple[SpecStruct, Optional[SpecStruct]]:
+    """Base transform per sample over the [task, sample] dims."""
+    base = self._base_preprocessor
+    rngs = jax.random.split(rng, 3) if rng is not None else (None, None, None)
+
+    def _sub(struct, prefix):
+      out = SpecStruct()
+      for key in struct:
+        if key.startswith(prefix):
+          out[key[len(prefix):]] = struct[key]
+      return out
+
+    def _apply(feats, labs, sub_rng):
+      def fn(f, l):
+        return base._preprocess_fn(SpecStruct(**f), SpecStruct(**l) if l
+                                   else None, mode, sub_rng)
+      out_f, out_l = meta_data.multi_batch_apply(
+          fn, 2, dict(feats), dict(labs) if labs is not None else {})
+      return out_f, out_l
+
+    cond_f, cond_l = _apply(_sub(features, 'condition/features/'),
+                            _sub(features, 'condition/labels/'), rngs[0])
+    inf_f, _ = _apply(_sub(features, 'inference/features/'), None, rngs[1])
+    out = SpecStruct()
+    for key in cond_f:
+      out['condition/features/' + key] = cond_f[key]
+    for key in (cond_l or {}):
+      out['condition/labels/' + key] = cond_l[key]
+    for key in inf_f:
+      out['inference/features/' + key] = inf_f[key]
+    # Meta labels ride through unchanged: base preprocessors transform
+    # labels only alongside their features, which outer-loss labels lack.
+    return out, labels

@@ -279,9 +279,10 @@ class PerEpisodeSwitchPolicy(Policy):
     self._explore_policy.init_randomly()
     self._greedy_policy.init_randomly()
 
-  def restore(self) -> None:
-    self._explore_policy.restore()
-    self._greedy_policy.restore()
+  def restore(self):
+    explore_ok = self._explore_policy.restore()
+    greedy_ok = self._greedy_policy.restore()
+    return (explore_ok is not False) and (greedy_ok is not False)
 
   @property
   def global_step(self) -> int:

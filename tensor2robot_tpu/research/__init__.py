@@ -1,0 +1,1 @@
+"""Research workloads: concrete models + envs built on the framework."""

@@ -1,0 +1,25 @@
+"""Pose prediction toy task: the end-to-end smoke-test workload."""
+
+from tensor2robot_tpu.research.pose_env.pose_env import (
+    PoseEnvRandomPolicy,
+    PoseToyEnv,
+)
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    DefaultPoseEnvContinuousPreprocessor,
+    DefaultPoseEnvRegressionPreprocessor,
+    PoseEnvContinuousMCModel,
+    PoseEnvRegressionModel,
+)
+from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
+    episode_to_transitions_pose_toy,
+)
+
+__all__ = [
+    'DefaultPoseEnvContinuousPreprocessor',
+    'DefaultPoseEnvRegressionPreprocessor',
+    'PoseEnvContinuousMCModel',
+    'PoseEnvRandomPolicy',
+    'PoseEnvRegressionModel',
+    'PoseToyEnv',
+    'episode_to_transitions_pose_toy',
+]

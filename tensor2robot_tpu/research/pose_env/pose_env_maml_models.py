@@ -1,0 +1,67 @@
+"""MAML models for the duck pose task.
+
+Parity target: /root/reference/research/pose_env/pose_env_maml_models.py:33-107
+(PoseEnvRegressionModelMAML): regression MAML whose robot-time features pack
+the conditioning demo episode next to the inference state, with zero-reward
+dummy episodes masking the inner gradient step when no demo exists yet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from tensor2robot_tpu.meta_learning.maml_model import MAMLRegressionModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.pose_env.pose_env_models import (
+    PoseEnvRegressionModel,
+)
+
+
+class PoseEnvRegressionModelMAML(MAMLRegressionModel):
+  """MAML regression for the duck task (ref :33)."""
+
+  def __init__(self, base_model: Optional[PoseEnvRegressionModel] = None,
+               **kwargs):
+    super().__init__(base_model=base_model or PoseEnvRegressionModel(),
+                     **kwargs)
+
+  def _make_dummy_labels(self) -> dict:
+    """Zero labels whose reward=0 masks the inner gradient (ref :36-45)."""
+    label_spec = self._base_model.get_label_specification(ModeKeys.TRAIN)
+    return {
+        'target_pose': np.zeros(tuple(label_spec['target_pose'].shape),
+                                np.float32),
+        'reward': np.zeros(tuple(label_spec['reward'].shape), np.float32),
+    }
+
+  def pack_features(self, state, prev_episode_data, timestep) -> dict:
+    """Packs demo episode + current state into the meta layout (ref :56).
+
+    Missing demos become dummy zero-reward condition samples so the inner
+    loop applies no gradient (weighted loss contributes zero).
+    """
+    del timestep
+    if prev_episode_data:
+      obs, action, reward = (prev_episode_data[0][0][0],
+                             prev_episode_data[0][0][1],
+                             prev_episode_data[0][0][2])
+      cond_state = np.asarray(obs)
+      cond_labels = {
+          'target_pose': np.asarray(action, np.float32),
+          'reward': np.asarray([2.0 * reward - 1.0], np.float32),
+      }
+    else:
+      dummy = self._make_dummy_labels()
+      cond_state = np.asarray(state)
+      cond_labels = {'target_pose': dummy['target_pose'],
+                     'reward': dummy['reward']}
+    # [task=1, samples=1, ...] layout.
+    expand = lambda x: np.asarray(x)[None, None]
+    return {
+        'condition/features/state': expand(cond_state),
+        'condition/labels/target_pose': expand(cond_labels['target_pose']),
+        'condition/labels/reward': expand(cond_labels['reward']),
+        'inference/features/state': expand(np.asarray(state)),
+    }

@@ -1,0 +1,215 @@
+"""Models for the duck pose prediction task.
+
+Parity target: /root/reference/research/pose_env/pose_env_models.py:45-329
+(DefaultPoseEnvContinuousPreprocessor, PoseEnvContinuousMCModel,
+DefaultPoseEnvRegressionPreprocessor, PoseEnvRegressionModel). The slim conv
+stacks become Flax modules over the shared vision_layers towers; uint8->f32
+image conversion stays in the preprocessor, which runs INSIDE the jitted
+step on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs.algebra import flatten_spec_structure
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+def _convert_image(image):
+  """uint8 [0, 255] -> float32 [0, 1] (ref tf.image.convert_image_dtype)."""
+  if jnp.issubdtype(jnp.asarray(image).dtype, jnp.floating):
+    return jnp.asarray(image, jnp.float32)
+  return jnp.asarray(image, jnp.float32) / 255.0
+
+
+class DefaultPoseEnvContinuousPreprocessor(AbstractPreprocessor):
+  """uint8 images on disk -> float32 for the critic (ref :45-92)."""
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    model_spec = flatten_spec_structure(
+        self._model_feature_specification(mode))
+    spec = SpecStruct()
+    spec['state/image'] = TensorSpec.from_spec(
+        model_spec['state/image'], dtype=np.uint8)
+    spec['action/pose'] = model_spec['action/pose']
+    return spec
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_label_specification(mode))
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_feature_specification(mode))
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_label_specification(mode))
+
+  def _preprocess_fn(self, features, labels, mode, rng=None):
+    features['state/image'] = _convert_image(features['state/image'])
+    return features, labels
+
+
+class _QNetwork(nn.Module):
+  """Conv state tower + broadcast action context -> scalar Q (ref :120-178)."""
+
+  channels: int = 32
+
+  @nn.compact
+  def __call__(self, features, mode: str = 'train', train: bool = False):
+    net = _convert_image(features['state/image'])
+    for i in range(3):
+      net = nn.Conv(self.channels, (3, 3), padding='SAME',
+                    name='conv{}'.format(i))(net)
+      net = nn.LayerNorm(name='norm{}'.format(i))(net)
+      net = nn.relu(net)
+    action = jnp.asarray(features['action/pose'], jnp.float32)
+    action_context = nn.relu(nn.Dense(self.channels, name='action_fc')(action))
+    net = net + action_context[:, None, None, :]
+    net = net.reshape((net.shape[0], -1))
+    for i, width in enumerate((100, 100)):
+      net = nn.Dense(width, name='fc{}'.format(i))(net)
+      net = nn.LayerNorm(name='fc_norm{}'.format(i))(net)
+      net = nn.relu(net)
+    q = nn.Dense(1, name='q_head')(net)
+    return {'q_predicted': jnp.squeeze(q, -1)}
+
+
+class PoseEnvContinuousMCModel(CriticModel):
+  """Continuous Monte-Carlo Q model for the pose env (ref :96)."""
+
+  def __init__(self, preprocessor_cls=DefaultPoseEnvContinuousPreprocessor,
+               **kwargs):
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
+
+  def get_state_specification(self) -> SpecStruct:
+    return SpecStruct(image=TensorSpec(
+        (64, 64, 3), np.float32, name='state/image', data_format='jpeg'))
+
+  def get_action_specification(self) -> SpecStruct:
+    return SpecStruct(pose=TensorSpec((2,), np.float32, name='pose'))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    return SpecStruct(reward=TensorSpec((), np.float32, name='reward'))
+
+  def create_network(self) -> nn.Module:
+    return _QNetwork()
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    # MC regression on the (negative-distance) return, not log loss: the
+    # pose env's rewards are not in [0, 1] (ref q_func + default loss).
+    q = inference_outputs['q_predicted']
+    target = jnp.asarray(labels['reward'], q.dtype).reshape(q.shape)
+    loss = jnp.mean((q - target).astype(jnp.float32) ** 2)
+    return loss, SpecStruct()
+
+  def pack_features(self, state, context, timestep, actions) -> dict:
+    """One observation + N candidate actions for CEM (ref :180-184)."""
+    del context, timestep
+    return {'state/image': np.expand_dims(state, 0),
+            'action/pose': np.asarray(actions, np.float32)}
+
+
+class DefaultPoseEnvRegressionPreprocessor(AbstractPreprocessor):
+  """uint8 images on disk -> float32 for regression (ref :187-231)."""
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    model_spec = flatten_spec_structure(
+        self._model_feature_specification(mode))
+    spec = SpecStruct()
+    spec['state'] = TensorSpec.from_spec(model_spec['state'], dtype=np.uint8)
+    return spec
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_label_specification(mode))
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_feature_specification(mode))
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return flatten_spec_structure(self._model_label_specification(mode))
+
+  def _preprocess_fn(self, features, labels, mode, rng=None):
+    features['state'] = _convert_image(features['state'])
+    return features, labels
+
+
+class _RegressionNetwork(nn.Module):
+  """Vision tower -> spatial softmax keypoints -> pose head (ref a_func)."""
+
+  action_size: int = 2
+
+  @nn.compact
+  def __call__(self, features, mode: str = 'train', train: bool = False):
+    image = _convert_image(features['state'])
+    feature_points, _ = vision_layers.ImagesToFeaturesNet(
+        name='state_features')(image, train=train)
+    estimated_pose = vision_layers.ImageFeaturesToPoseNet(
+        num_outputs=self.action_size, name='pose_net')(feature_points)
+    return {'inference_output': estimated_pose,
+            'state_features': feature_points}
+
+
+class PoseEnvRegressionModel(RegressionModel):
+  """Image -> (x, y) pose regression (ref :235-329)."""
+
+  def __init__(self, action_size: int = 2,
+               preprocessor_cls=DefaultPoseEnvRegressionPreprocessor,
+               **kwargs):
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
+    self._action_size = action_size
+
+  @property
+  def action_size(self) -> int:
+    return self._action_size
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    return SpecStruct(state=TensorSpec(
+        (64, 64, 3), np.float32, name='state/image', data_format='jpeg'))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    return SpecStruct(
+        target_pose=TensorSpec((self._action_size,), np.float32,
+                               name='target_pose'),
+        reward=TensorSpec((1,), np.float32, name='reward'))
+
+  def create_network(self) -> nn.Module:
+    return _RegressionNetwork(action_size=self._action_size)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """Reward-weighted MSE against the true pose (ref loss_fn :324).
+
+    Matches tf.losses.mean_squared_error(weights=reward): the weighted
+    squared error summed, normalized by the count of nonzero weights.
+    """
+    predictions = inference_outputs['inference_output']
+    targets = jnp.asarray(labels['target_pose'], predictions.dtype)
+    weights = jnp.asarray(labels['reward'], jnp.float32)
+    squared = (predictions - targets).astype(jnp.float32) ** 2
+    weighted = squared * weights  # weights broadcast [B, 1] over action dims
+    num_present = jnp.maximum(
+        jnp.sum(jnp.where(weights != 0, 1.0, 0.0) *
+                jnp.ones_like(squared)), 1.0)
+    loss = jnp.sum(weighted) / num_present
+    return loss, SpecStruct()
+
+  def pack_features(self, state, context, timestep) -> dict:
+    del context, timestep
+    return {'state': np.expand_dims(state, 0)}

@@ -19,16 +19,9 @@ import os
 from typing import Any, Callable, Optional
 
 import numpy as np
+from absl import logging
 
-_log_fn = None
-
-
-def _log(msg: str, *args) -> None:
-  global _log_fn
-  if _log_fn is None:
-    from absl import logging
-    _log_fn = logging.info
-  _log_fn(msg, *args)
+_log = logging.info
 
 
 def _write_metrics(root_dir: str, tag: str, global_step: int,
@@ -100,7 +93,10 @@ def run_env(env,
         if done:
           _log('Episode %d reward: %f', ep, episode_reward)
           episode_rewards.append(episode_reward)
-          if replay_writer and episode_to_transitions_fn:
+          # Gated on record_prefix (not just the writer): root_dir=None
+          # means nothing is saved (ref :167-170), so the writer was
+          # never opened.
+          if record_prefix and episode_to_transitions_fn:
             replay_writer.write(episode_to_transitions_fn(episode_data))
       if episode_rewards and len(episode_rewards) % 10 == 0:
         _log('Average %d episodes reward: %f', len(episode_rewards),

@@ -171,6 +171,21 @@ def test_scheduled_exploration_policy_slope():
   np.testing.assert_array_equal(policy.SelectAction(0.1, None, 0), [0.0, 0.0])
 
 
+def test_per_episode_switch_policy_restore_propagates_failure():
+
+  class _FailRestorePolicy(Policy):
+
+    def SelectAction(self, state, context, timestep):
+      return 0
+
+    def restore(self):
+      return False
+
+  policy = PerEpisodeSwitchPolicy(_FailRestorePolicy, _FailRestorePolicy,
+                                  explore_prob=0.5)
+  assert policy.restore() is False
+
+
 def test_per_episode_switch_policy():
 
   class _Marker(Policy):

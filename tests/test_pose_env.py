@@ -1,0 +1,117 @@
+"""Pose-env end-to-end tests: the reference's full-stack smoke workload.
+
+Mirrors /root/reference/research/pose_env/pose_env_models_test.py: collect a
+small dataset with the random policy, train both models through the real
+harness from the TFRecords, and run the CEM/regression serving paths.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.input_generators import DefaultRecordInputGenerator
+from tensor2robot_tpu.data.writer import TFRecordReplayWriter
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.policies import CEMPolicy
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.research.pose_env import (
+    PoseEnvContinuousMCModel,
+    PoseEnvRandomPolicy,
+    PoseEnvRegressionModel,
+    PoseToyEnv,
+    episode_to_transitions_pose_toy,
+)
+from tensor2robot_tpu.rl import run_env
+from tensor2robot_tpu.trainer import Trainer, latest_checkpoint_step
+
+
+class TestPoseToyEnv:
+
+  def test_observation_and_reward(self):
+    env = PoseToyEnv(seed=0)
+    obs = env.reset()
+    assert obs.shape == (64, 64, 3) and obs.dtype == np.uint8
+    # The duck must actually be visible (yellow pixels on brown/gray).
+    assert (obs[..., 0].astype(int) - obs[..., 2].astype(int) > 100).any()
+    target = env._target_pose[:2]
+    obs2, reward, done, debug = env.step(target)
+    assert done
+    np.testing.assert_allclose(reward, 0.0, atol=1e-6)
+    np.testing.assert_allclose(debug['target_pose'], target, atol=1e-6)
+    _, reward_off, _, _ = env.step(target + np.array([0.3, 0.4]))
+    np.testing.assert_allclose(reward_off, -0.5, atol=1e-5)
+
+  def test_new_pose_each_episode_fixed_camera(self):
+    env = PoseToyEnv(seed=1)
+    obs_a, pose_a = env.reset(), env._target_pose.copy()
+    obs_b, pose_b = env.reset(), env._target_pose.copy()
+    assert not np.allclose(pose_a, pose_b)
+    assert not np.array_equal(obs_a, obs_b)
+
+  def test_hidden_drift_offsets_target(self):
+    env = PoseToyEnv(seed=2, hidden_drift=True)
+    env.reset()
+    drift = env._target_pose - env._rendered_pose
+    assert np.abs(drift[:2]).max() > 0
+    assert drift[2] == 0
+
+
+@pytest.fixture(scope='module')
+def collected_records(tmp_path_factory):
+  """~24 single-step episodes of random-policy data, as TFRecords."""
+  root = str(tmp_path_factory.mktemp('pose_data'))
+  env = PoseToyEnv(seed=3)
+  run_env(env, policy=PoseEnvRandomPolicy(), num_episodes=24,
+          episode_to_transitions_fn=episode_to_transitions_pose_toy,
+          replay_writer=TFRecordReplayWriter(), root_dir=root,
+          global_step=0, tag='collect')
+  (path,) = glob.glob(os.path.join(root, 'policy_collect', '*'))
+  return path
+
+
+class TestPoseEnvRegressionModel:
+
+  def test_train_from_records_and_serve(self, collected_records, tmp_path):
+    model = PoseEnvRegressionModel()
+    generator = DefaultRecordInputGenerator(
+        file_patterns=collected_records, batch_size=8)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    state = trainer.train(generator, max_train_steps=2)
+    trainer.close()
+    assert latest_checkpoint_step(str(tmp_path)) == 2
+    # Serving: raw uint8 observation through the checkpoint predictor.
+    predictor = CheckpointPredictor(PoseEnvRegressionModel(), str(tmp_path),
+                                    timeout=5.0)
+    assert predictor.restore()
+    env = PoseToyEnv(seed=4)
+    features = model.pack_features(env.reset(), None, None)
+    outputs = predictor.predict(features)
+    assert outputs['inference_output'].shape == (1, 2)
+    predictor.close()
+
+
+class TestPoseEnvMCModel:
+
+  def test_train_from_records_and_cem_policy(self, collected_records,
+                                             tmp_path):
+    cem_samples = 16
+    model = PoseEnvContinuousMCModel()
+    generator = DefaultRecordInputGenerator(
+        file_patterns=collected_records, batch_size=8)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    trainer.train(generator, max_train_steps=2)
+    trainer.close()
+    serving_model = PoseEnvContinuousMCModel(action_batch_size=cem_samples)
+    predictor = CheckpointPredictor(serving_model, str(tmp_path), timeout=5.0)
+    assert predictor.restore()
+    policy = CEMPolicy(
+        t2r_model=serving_model, action_size=2, cem_iters=2,
+        cem_samples=cem_samples, num_elites=4, predictor=predictor)
+    env = PoseToyEnv(seed=5)
+    action = policy.SelectAction(env.reset(), None, 0)
+    assert np.asarray(action).shape == (2,)
+    predictor.close()

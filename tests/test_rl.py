@@ -91,6 +91,15 @@ def test_run_env_writes_replay_records(tmp_path):
   assert 'reward' in parsed
 
 
+def test_run_env_writer_without_root_dir_is_noop(tmp_path):
+  # Regression: root_dir=None means nothing is saved; the writer must not
+  # be written to (it was never opened).
+  rewards = run_env(_CountdownEnv(), policy=_ConstPolicy(), num_episodes=2,
+                    episode_to_transitions_fn=_episode_to_transitions,
+                    replay_writer=TFRecordReplayWriter(), root_dir=None)
+  assert rewards == [3.0, 3.0]
+
+
 def test_collect_eval_loop_single_pass(tmp_path):
   calls = []
 
