@@ -1,0 +1,25 @@
+"""QT-Opt: vision-based grasping Q-learning (arXiv 1806.10293)."""
+
+from tensor2robot_tpu.research.qtopt.networks import (
+    Grasping44Network,
+    NUM_SAMPLES,
+)
+from tensor2robot_tpu.research.qtopt.optimizer_builder import (
+    build_opt,
+    default_hparams,
+)
+from tensor2robot_tpu.research.qtopt.t2r_models import (
+    DefaultGrasping44ImagePreprocessor,
+    Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    LegacyGraspingModelWrapper,
+)
+
+__all__ = [
+    'DefaultGrasping44ImagePreprocessor',
+    'Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom',
+    'Grasping44Network',
+    'LegacyGraspingModelWrapper',
+    'NUM_SAMPLES',
+    'build_opt',
+    'default_hparams',
+]

@@ -1,0 +1,195 @@
+"""QT-Opt grasping Q-networks (the Grasping44 PNN family).
+
+Parity target: /root/reference/research/qtopt/networks.py:44-760
+(GraspingModel, Grasping44FlexibleGraspParams :304, the E2E open/close/
+terminate variant :623). The 19-layer conv architecture (NUM_LAYERS :35):
+
+  conv1_1 64x6x6/2 -> bn(noscale) relu -> pool 3x3/3
+  conv2..7 64x5x5 SAME (+bn relu) -> pool 3x3/3
+  grasp params: per-block Dense 256 summed -> bn(noscale) relu
+                -> Dense 64 (+bn relu) -> broadcast-add as [*,1,1,64] context
+  conv8..13 64x3x3 SAME (+bn relu) -> pool 2x2/2
+  conv14..16 64x3x3 VALID (+bn relu) -> flatten -> fc 64 x2 -> logit
+
+TPU-first notes:
+  * The CEM action-megabatch trick is preserved (ref :419-427, :520-527):
+    with ``grasp_params`` of rank 3 [batch, action_batch, d], the image
+    tower runs ONCE per state and only the embedding is tiled across the
+    action batch — the MXU sees one large fused batch for the post-merge
+    convs.
+  * ``dtype`` selects the activations dtype (bfloat16 on TPU); the logit
+    head and batch-norm statistics stay float32.
+  * l2 regularization (ref slim weights_regularizer :438) is returned as
+    an explicit ``l2_regularization_loss`` endpoint, added to the training
+    loss by the model wrapper (the slim REGULARIZATION_LOSSES analog).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+NUM_LAYERS = 19
+BATCH_SIZE = 64
+# Action samples when estimating max_a Q(s, a) (ref :37-41).
+NUM_SAMPLES = 100
+
+# grasp_param block layout of the E2E variant (ref networks.py:736-744):
+# name -> (offset, size) into the concatenated grasp params vector.
+E2E_GRASP_PARAM_NAMES = {
+    'fcgrasp_wv': (0, 3),
+    'fcgrasp_vr': (3, 2),
+    'fcgrasp_gripper_close': (5, 1),
+    'fcgrasp_gripper_open': (6, 1),
+    'fcgrasp_terminate_episode': (7, 1),
+    'fcgrasp_gripper_closed': (8, 1),
+    'fcgrasp_height_to_bottom': (9, 1),
+}
+
+# Concatenation order of action features (ref grasp_model_input_keys :637).
+E2E_GRASP_PARAM_KEYS = (
+    'world_vector', 'vertical_rotation', 'close_gripper', 'open_gripper',
+    'terminate_episode', 'gripper_closed', 'height_to_bottom')
+
+
+class Grasping44Network(nn.Module):
+  """The Grasping44 Q-network (ref Grasping44FlexibleGraspParams :304)."""
+
+  num_classes: int = 1
+  num_convs: Sequence[int] = (6, 6, 3)
+  hid_layers: int = 2
+  batch_norm_decay: float = 0.9997
+  batch_norm_epsilon: float = 0.001
+  l2_regularization: float = 0.00007
+  grasp_param_names: Optional[Dict[str, Tuple[int, int]]] = None
+  softmax: bool = False
+  dtype: jnp.dtype = jnp.float32
+
+  def _conv(self, features, kernel, stride, padding, name):
+    return nn.Conv(
+        features=features, kernel_size=(kernel, kernel),
+        strides=(stride, stride), padding=padding, use_bias=True,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        dtype=self.dtype, name=name)
+
+  def _dense(self, features, name):
+    return nn.Dense(
+        features,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        dtype=self.dtype, name=name)
+
+  def _bn(self, net, train, scale, name):
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=self.batch_norm_decay,
+        epsilon=self.batch_norm_epsilon, use_scale=scale,
+        dtype=self.dtype, name=name)(net)
+
+  @nn.compact
+  def __call__(self, image, grasp_params, train: bool = False):
+    """Args:
+      image: [batch, H, W, 3] grasp image (472x472 nominal).
+      grasp_params: [batch, d] or [batch, action_batch, d] (CEM megabatch).
+      train: batch-norm mode.
+
+    Returns:
+      endpoints dict with 'logits', 'predictions' (sigmoid/softmax, shaped
+      [batch, action_batch] in megabatch mode), 'pool2', 'final_conv',
+      'l2_regularization_loss'.
+    """
+    endpoints = {}
+    tile_batch = grasp_params.ndim == 3
+    action_batch_size = grasp_params.shape[1] if tile_batch else 1
+    if tile_batch:
+      grasp_params = grasp_params.reshape((-1, grasp_params.shape[-1]))
+
+    net = jnp.asarray(image, self.dtype)
+    net = self._conv(64, 6, 2, 'SAME', 'conv1_1')(net)
+    net = nn.relu(self._bn(net, train, scale=False, name='bn1'))
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    layer = 2
+    for _ in range(self.num_convs[0]):
+      net = self._conv(64, 5, 1, 'SAME', 'conv{}'.format(layer))(net)
+      net = self._bn(net, train, True, 'bn{}'.format(layer))
+      net = nn.relu(net)
+      layer += 1
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    endpoints['pool2'] = net
+
+    grasp_params = jnp.asarray(grasp_params, self.dtype)
+    if self.grasp_param_names is None:
+      blocks = [('fcgrasp', grasp_params)]
+    else:
+      # Sorted for deterministic parameter creation (ref :482-486).
+      blocks = [
+          (name, grasp_params[:, offset:offset + size])
+          for name, (offset, size) in sorted(self.grasp_param_names.items())
+      ]
+    fcgrasp = sum(self._dense(256, name)(block) for name, block in blocks)
+    fcgrasp = nn.relu(self._bn(fcgrasp, train, scale=False, name='bngrasp'))
+    fcgrasp = self._dense(64, 'fcgrasp2')(fcgrasp)
+    fcgrasp = nn.relu(self._bn(fcgrasp, train, True, 'bngrasp2'))
+    endpoints['fcgrasp'] = fcgrasp
+    context = fcgrasp.reshape((-1, 1, 1, 64))
+
+    if tile_batch:
+      # Tile the IMAGE EMBEDDING (not the raw image) across the action
+      # batch: [B, h, w, c] -> [B * action_batch, h, w, c] with each
+      # state's block contiguous (ref contrib_seq2seq.tile_batch :526).
+      net = jnp.repeat(net, action_batch_size, axis=0)
+    net = net + context
+    endpoints['vsum'] = net
+
+    for _ in range(self.num_convs[1]):
+      net = self._conv(64, 3, 1, 'SAME', 'conv{}'.format(layer))(net)
+      net = self._bn(net, train, True, 'bn{}'.format(layer))
+      net = nn.relu(net)
+      layer += 1
+    net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
+    for _ in range(self.num_convs[2]):
+      net = self._conv(64, 3, 1, 'VALID', 'conv{}'.format(layer))(net)
+      net = self._bn(net, train, True, 'bn{}'.format(layer))
+      net = nn.relu(net)
+      layer += 1
+    endpoints['final_conv'] = net
+
+    net = net.reshape((net.shape[0], -1))
+    for l in range(self.hid_layers):
+      net = self._dense(64, 'fc{}'.format(l))(net)
+      net = self._bn(net, train, True, 'bnfc{}'.format(l))
+      net = nn.relu(net)
+    name = 'logit' if self.num_classes == 1 else 'logit_{}'.format(
+        self.num_classes)
+    logits = nn.Dense(
+        self.num_classes,
+        kernel_init=nn.initializers.truncated_normal(stddev=0.01),
+        dtype=jnp.float32, name=name)(jnp.asarray(net, jnp.float32))
+    endpoints['logits'] = logits
+    predictions = (nn.softmax(logits) if self.softmax
+                   else nn.sigmoid(logits))
+    if tile_batch:
+      new_shape = ((-1, action_batch_size) if self.num_classes == 1 else
+                   (-1, action_batch_size, self.num_classes))
+      predictions = predictions.reshape(new_shape)
+      logits = logits.reshape(new_shape)
+      endpoints['logits'] = logits
+    elif self.num_classes == 1:
+      predictions = jnp.squeeze(predictions, -1)
+    endpoints['predictions'] = predictions
+    return endpoints
+
+
+def l2_regularization_loss(params, scale: float) -> jnp.ndarray:
+  """slim REGULARIZATION_LOSSES analog: ``scale * sum ||kernel||^2 / 2``.
+
+  Applied to conv/dense kernels only (slim regularizes weights, not biases
+  or batch-norm params; ref arg_scope :438).
+  """
+  import jax
+
+  total = 0.0
+  for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+    if str(getattr(path[-1], 'key', '')) == 'kernel':
+      total = total + jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+  return scale * 0.5 * total
