@@ -109,29 +109,34 @@ class MAMLInnerLoopGradientDescent:
 
     Returns:
       ([unconditioned_outputs, conditioned_outputs], inner_outputs,
-       inner_losses) exactly as the reference (:332): inner_outputs has
-       k+1 entries (the extra final forward monitors adaptation), and
-       inner_losses the matching k+1 scalars.
+       inner_losses, new_model_state) — the first three exactly as the
+       reference (:332): inner_outputs has k+1 entries (the extra final
+       forward monitors adaptation) and inner_losses the matching k+1
+       scalars. ``new_model_state`` carries the base model's mutable
+       collections (batch_stats) threaded through every train-mode forward
+       pass (the reference collects the matching BN update_ops); it equals
+       ``model_state`` when nothing mutates.
     """
 
-    def forward(p, features, labels):
-      variables = {'params': p, **(model_state or {})}
-      outputs, _ = inference_network_fn(variables, features, labels, mode,
-                                        rng)
-      return outputs
+    def forward(p, state, features, labels):
+      variables = {'params': p, **(state or {})}
+      outputs, new_state = inference_network_fn(variables, features, labels,
+                                                mode, rng)
+      return outputs, (new_state if new_state is not None else state)
 
-    def loss_fn(p, features, labels):
-      variables = {'params': p, **(model_state or {})}
-      outputs = forward(p, features, labels)
+    def loss_fn(p, state, features, labels):
+      variables = {'params': p, **(state or {})}
+      outputs, new_state = forward(p, state, features, labels)
       loss, _ = model_train_fn(variables, features, labels, outputs, mode)
-      return loss, outputs
+      return loss, (outputs, new_state)
 
     current = params
+    current_state = model_state
     inner_outputs: List[Any] = []
     inner_losses: List[jnp.ndarray] = []
     for features, labels in inputs_list[:-1]:
-      (loss, outputs), grads = jax.value_and_grad(
-          loss_fn, has_aux=True)(current, features, labels)
+      (loss, (outputs, current_state)), grads = jax.value_and_grad(
+          loss_fn, has_aux=True)(current, current_state, features, labels)
       inner_outputs.append(outputs)
       inner_losses.append(loss)
       current = self._adapt(current, grads, inner_lrs)
@@ -139,11 +144,16 @@ class MAMLInnerLoopGradientDescent:
     # One more conditioned forward + loss on the last condition batch to
     # monitor whether adaptation helped (ref :294-312) — no gradient step.
     final_features, final_labels = inputs_list[-2]
-    final_loss, final_outputs = loss_fn(current, final_features, final_labels)
+    final_loss, (final_outputs, current_state) = loss_fn(
+        current, current_state, final_features, final_labels)
     inner_outputs.append(final_outputs)
     inner_losses.append(final_loss)
 
     val_features, val_labels = inputs_list[-1]
-    conditioned = forward(current, val_features, val_labels)
-    unconditioned = forward(params, val_features, val_labels)
-    return [unconditioned, conditioned], inner_outputs, inner_losses
+    conditioned, current_state = forward(current, current_state,
+                                         val_features, val_labels)
+    # The unconditioned diagnostic pass does not contribute state updates.
+    unconditioned, _ = forward(params, current_state, val_features,
+                               val_labels)
+    return ([unconditioned, conditioned], inner_outputs, inner_losses,
+            current_state)

@@ -31,6 +31,7 @@ from tensor2robot_tpu.meta_learning.maml_inner_loop import (
     MAMLInnerLoopGradientDescent,
 )
 from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.specs.struct import SpecStruct
 
 INNER_LRS_KEY = 'maml_inner_lrs'
@@ -136,9 +137,18 @@ class MAMLModel(AbstractT2RModel):
           self._base_model.model_train_fn, mode, inner_lrs=inner_lrs,
           rng=rng)
 
-    (outputs, inner_outputs, inner_losses) = jax.vmap(task_learn)(
-        cond_f, cond_l, inf_f, val_l)
+    (outputs, inner_outputs, inner_losses, new_model_state) = jax.vmap(
+        task_learn)(cond_f, cond_l, inf_f, val_l)
     unconditioned, conditioned = outputs
+    # Mutable collections (batch_stats) come back with a leading task dim;
+    # the running stats are EMAs, so the cross-task mean is the batched
+    # analog of the reference's shared-variable BN update_ops.
+    if (mode == ModeKeys.TRAIN and model_state and
+        jax.tree_util.tree_leaves(model_state)):
+      new_model_state = jax.tree.map(lambda x: jnp.mean(x, axis=0),
+                                     new_model_state)
+    else:
+      new_model_state = None
 
     predictions = SpecStruct()
     for pos, step_outputs in enumerate(inner_outputs):
@@ -162,7 +172,7 @@ class MAMLModel(AbstractT2RModel):
     if 'inference_output' not in predictions:
       raise ValueError('_select_inference_output must assign '
                        'inference_output.')
-    return predictions, None
+    return predictions, new_model_state
 
   @abc.abstractmethod
   def _select_inference_output(self, predictions: SpecStruct) -> SpecStruct:

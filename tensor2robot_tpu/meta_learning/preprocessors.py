@@ -108,7 +108,13 @@ class MAMLPreprocessorV2(AbstractPreprocessor):
 
     cond_f, cond_l = _apply(_sub(features, 'condition/features/'),
                             _sub(features, 'condition/labels/'), rngs[0])
-    inf_f, _ = _apply(_sub(features, 'inference/features/'), None, rngs[1])
+    # Meta (outer-loss) labels are the base-preprocessed inference-split
+    # labels: the reference splits AFTER base preprocessing (ref map_fn in
+    # preprocessors.py), so they must see the same label transform
+    # (cast/normalize/one-hot) the condition labels do — paired with the
+    # inference features they belong to.
+    inf_f, out_labels = _apply(_sub(features, 'inference/features/'),
+                               labels, rngs[1])
     out = SpecStruct()
     for key in cond_f:
       out['condition/features/' + key] = cond_f[key]
@@ -116,6 +122,5 @@ class MAMLPreprocessorV2(AbstractPreprocessor):
       out['condition/labels/' + key] = cond_l[key]
     for key in inf_f:
       out['inference/features/' + key] = inf_f[key]
-    # Meta labels ride through unchanged: base preprocessors transform
-    # labels only alongside their features, which outer-loss labels lack.
-    return out, labels
+    return out, (SpecStruct(**out_labels) if labels is not None and out_labels
+                 else None)

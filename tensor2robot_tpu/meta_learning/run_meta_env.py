@@ -80,7 +80,9 @@ def run_meta_env(env,
       for _ in range(num_demos):
         episode_data = _run_demo_episode()
         condition_data.append(episode_data)
-        if replay_writer and episode_to_transitions_fn:
+        # Gated on record_name (not just the writer): without root_dir
+        # the writer was never opened (matches rl/run_env.py:96-100).
+        if record_name and episode_to_transitions_fn:
           replay_writer.write(episode_to_transitions_fn(episode_data))
       policy.adapt(copy.copy(condition_data))
     elif hasattr(env, 'task_data') and hasattr(policy, 'adapt'):
@@ -116,7 +118,7 @@ def run_meta_env(env,
             _log('Step %d episode %d reward: %f', step_num, ep,
                  episode_reward)
             task_step_rewards[task_idx][step_num].append(episode_reward)
-            if replay_writer and episode_to_transitions_fn:
+            if record_name and episode_to_transitions_fn:
               replay_writer.write(episode_to_transitions_fn(episode_data))
         condition_data.append(episode_data)
     _log('Task %d avg reward: %f', task_idx,

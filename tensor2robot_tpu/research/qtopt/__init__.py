@@ -3,23 +3,33 @@
 from tensor2robot_tpu.research.qtopt.networks import (
     Grasping44Network,
     NUM_SAMPLES,
+    l2_regularization_loss,
 )
 from tensor2robot_tpu.research.qtopt.optimizer_builder import (
+    build_learning_rate_schedule,
     build_opt,
     default_hparams,
 )
 from tensor2robot_tpu.research.qtopt.t2r_models import (
+    CEM_ACTION_SIZE,
     DefaultGrasping44ImagePreprocessor,
     Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+    GraspingQNetwork,
     LegacyGraspingModelWrapper,
+    pack_features_kuka_e2e,
 )
 
 __all__ = [
+    'CEM_ACTION_SIZE',
     'DefaultGrasping44ImagePreprocessor',
     'Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom',
     'Grasping44Network',
+    'GraspingQNetwork',
     'LegacyGraspingModelWrapper',
     'NUM_SAMPLES',
+    'build_learning_rate_schedule',
     'build_opt',
     'default_hparams',
+    'l2_regularization_loss',
+    'pack_features_kuka_e2e',
 ]

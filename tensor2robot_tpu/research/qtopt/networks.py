@@ -95,8 +95,9 @@ class Grasping44Network(nn.Module):
 
     Returns:
       endpoints dict with 'logits', 'predictions' (sigmoid/softmax, shaped
-      [batch, action_batch] in megabatch mode), 'pool2', 'final_conv',
-      'l2_regularization_loss'.
+      [batch, action_batch] in megabatch mode), 'pool2', 'final_conv'.
+      Weight decay is NOT an endpoint: compute it from the params pytree
+      with the module-level ``l2_regularization_loss(params, scale)``.
     """
     endpoints = {}
     tile_batch = grasp_params.ndim == 3

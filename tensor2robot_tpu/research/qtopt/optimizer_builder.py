@@ -1,0 +1,84 @@
+"""Legacy QT-Opt optimizer construction (hparams -> optax chain).
+
+Parity target: /root/reference/research/qtopt/optimizer_builder.py:29-100
+(``BuildOpt``) plus the hparam defaults injected by the model wrapper
+(/root/reference/research/qtopt/t2r_models.py:82-93). Semantics preserved:
+
+  * exponential-decay learning rate with ``staircase=True`` and
+    ``decay_steps = examples_per_epoch / batch_size * num_epochs_per_decay``
+    (ref optimizer_builder.py:66-74);
+  * optimizer selection 'momentum' | 'rmsprop' | adam-fallback with the
+    legacy hyperparameters (momentum doubles as adam beta1, ref :78-91);
+  * ``use_avg_model_params`` — the reference wraps the optimizer in
+    ``MovingAverageOptimizer`` whose swapping saver checkpoints averaged
+    weights (ref :93-98). TPU-natively the average is an ``optax.ema``
+    tracked in ``TrainState.avg_params`` (models/abstract_model.py), which
+    eval/serving read; ``build_opt`` therefore returns only the gradient
+    transformation and callers pass ``use_avg_model_params`` +
+    ``model_weights_averaging`` to the model base class.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def default_hparams(**overrides) -> dict:
+  """The legacy QT-Opt hparams (ref t2r_models.py:82-93)."""
+  hparams = dict(
+      batch_size=32,
+      examples_per_epoch=3000000,
+      learning_rate=1e-4,
+      learning_rate_decay_factor=0.999,
+      model_weights_averaging=0.9999,
+      momentum=0.9,
+      num_epochs_per_decay=2.0,
+      optimizer='momentum',
+      rmsprop_decay=0.9,
+      rmsprop_epsilon=1.0,
+      adam_beta2=0.999,
+      adam_epsilon=1e-8,
+      use_avg_model_params=True,
+  )
+  hparams.update(overrides)
+  return hparams
+
+
+def build_learning_rate_schedule(hparams: dict) -> optax.Schedule:
+  """Staircased exponential decay (ref optimizer_builder.py:63-74)."""
+  decay_steps = int(hparams['examples_per_epoch'] / hparams['batch_size'] *
+                    hparams['num_epochs_per_decay'])
+  return optax.exponential_decay(
+      init_value=hparams['learning_rate'],
+      transition_steps=decay_steps,
+      decay_rate=hparams['learning_rate_decay_factor'],
+      staircase=True)
+
+
+def build_opt(hparams: Optional[dict] = None) -> optax.GradientTransformation:
+  """Constructs the legacy optimizer chain (ref BuildOpt :29-100).
+
+  Returns an optax GradientTransformation; parameter averaging is NOT part
+  of the chain (see module docstring).
+  """
+  if hparams is None:
+    hparams = default_hparams()
+  learning_rate = build_learning_rate_schedule(hparams)
+  optimizer = hparams['optimizer']
+  if optimizer == 'momentum':
+    return optax.sgd(learning_rate, momentum=hparams['momentum'])
+  if optimizer == 'rmsprop':
+    # tf.train.RMSPropOptimizer(decay, momentum, epsilon) semantics:
+    # uncentered second-moment accumulator + momentum on the scaled step.
+    return optax.rmsprop(
+        learning_rate,
+        decay=hparams['rmsprop_decay'],
+        momentum=hparams['momentum'],
+        eps=hparams['rmsprop_epsilon'])
+  return optax.adam(
+      learning_rate,
+      b1=hparams['momentum'],
+      b2=hparams.get('adam_beta2', 0.999),
+      eps=hparams.get('adam_epsilon', 1e-8))

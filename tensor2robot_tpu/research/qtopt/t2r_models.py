@@ -1,0 +1,299 @@
+"""QT-Opt T2R models: the Grasping44 critic wrapped for the T2R stack.
+
+Parity target: /root/reference/research/qtopt/t2r_models.py:50-405
+(``pack_features_kuka_e2e`` :50, ``LegacyGraspingModelWrapper`` :66,
+``DefaultGrasping44ImagePreprocessor`` :246, the E2E open/close/terminate
+model :316). The TF1 responsibilities map as:
+
+  * legacy hparams + BuildOpt optimizer (ref :82-100) -> ``optimizer_builder``
+    optax chain; MovingAverageOptimizer/swapping-saver becomes
+    ``use_avg_model_params`` EMA in TrainState (eval/serve read averaged
+    params), see optimizer_builder.py docstring.
+  * ``q_func`` building the slim graph (ref :143-162,:370-397) -> a Flax
+    module (``GraspingQNetwork``) extracting image + grasp params from the
+    spec-validated feature struct and running ``Grasping44Network``.
+  * slim REGULARIZATION_LOSSES picked up by tf.losses.get_total_loss()
+    (ref model_train_fn :233-243) -> explicit ``l2_regularization_loss``
+    added to the sigmoid-cross-entropy grasp loss.
+  * CEM action tiling via contrib_seq2seq.tile_batch (ref networks.py:520-527,
+    concat_axis=2 in PREDICT :380-385) -> the action megabatch: candidate
+    actions arrive flat ``[B*action_batch, d]``, are reshaped to
+    ``[B, action_batch, d]``, and the image tower runs ONCE per state —
+    only the embedding is tiled, so the MXU sees one large fused batch.
+
+The preprocessor takes 512x640 uint8 camera images (jpeg on disk), random-
+crops (train) or center-crops (eval/predict) to 472x472, converts to [0,1]
+float and applies the paper's photometric distortions — all inside the jitted
+step on device (the reference does this on host CPU in tf.data).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensor2robot_tpu.models import abstract_model
+from tensor2robot_tpu.models.critic_model import CriticModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors.spec_transformation_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.research.qtopt import networks
+from tensor2robot_tpu.research.qtopt import optimizer_builder
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+INPUT_SHAPE = (512, 640, 3)
+TARGET_SHAPE = (472, 472)
+
+# Flat [N, 10] action-vector layout used by pack_features_kuka_e2e: the
+# first 8 dims are CEM-sampled controls, the last 2 are gripper status
+# carried in the action spec (ref get_action_specification :341-364).
+ACTION_DIM_LAYOUT = (
+    ('world_vector', 3),
+    ('vertical_rotation', 2),
+    ('close_gripper', 1),
+    ('open_gripper', 1),
+    ('terminate_episode', 1),
+)
+CEM_ACTION_SIZE = 8  # world_vector + vertical_rotation + 3 discrete controls
+
+
+def pack_features_kuka_e2e(t2r_model, state, context, timestep, actions
+                           ) -> Dict[str, np.ndarray]:
+  """Packs one observation + N candidate actions for the CEM predictor.
+
+  The reference's implementation is stripped from the OSS release
+  (ref t2r_models.py:50-61 raises NotImplementedError); this provides the
+  behavior its callers (CEM policies, ref policies.py:139-172) require.
+
+  Args:
+    t2r_model: the model (unused; kept for the reference pack_fn signature).
+    state: observation dict with 'image' (uint8 [512, 640, 3] camera frame),
+      'gripper_closed' and 'height_to_bottom' scalars.
+    context: unused.
+    timestep: unused.
+    actions: [N, 8] CEM samples laid out per ACTION_DIM_LAYOUT.
+
+  Returns:
+    Numpy feed dict matching the preprocessor's PREDICT in-spec: the raw
+    image once (batch 1; the device-side preprocessor center-crops it) and
+    the N candidate actions.
+  """
+  del t2r_model, context, timestep
+  actions = np.asarray(actions, np.float32)
+  num_samples = actions.shape[0]
+  features = {'state/image': np.expand_dims(np.asarray(state['image']), 0)}
+  offset = 0
+  for key, size in ACTION_DIM_LAYOUT:
+    features['action/' + key] = actions[:, offset:offset + size]
+    offset += size
+  for key in ('gripper_closed', 'height_to_bottom'):
+    features['action/' + key] = np.full(
+        (num_samples, 1), np.float32(state[key]))
+  return features
+
+
+class GraspingQNetwork(nn.Module):
+  """Feature-struct adapter around ``Grasping44Network``.
+
+  Extracts the grasp image and concatenates the action features (in the
+  reference's ``grasp_model_input_keys`` order, networks.py:637), handling
+  the PREDICT-mode action megabatch (see module docstring).
+  """
+
+  grasp_param_keys: Tuple[str, ...] = networks.E2E_GRASP_PARAM_KEYS
+  grasp_param_names: Optional[Dict[str, Tuple[int, int]]] = None
+  dtype: jnp.dtype = jnp.float32
+  network_kwargs: Optional[Dict[str, Any]] = None
+
+  @nn.compact
+  def __call__(self, features, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    image = jnp.asarray(features['state/image'])
+    grasp_params = jnp.concatenate(
+        [jnp.asarray(features['action/' + key], jnp.float32).reshape(
+            (jnp.asarray(features['action/' + key]).shape[0], -1))
+         for key in self.grasp_param_keys], axis=-1)
+    batch = image.shape[0]
+    if grasp_params.shape[0] != batch:
+      # CEM megabatch: N candidate actions per state arrive flat [B*A, d].
+      grasp_params = grasp_params.reshape(
+          (batch, -1, grasp_params.shape[-1]))
+    endpoints = networks.Grasping44Network(
+        grasp_param_names=self.grasp_param_names, dtype=self.dtype,
+        name='grasping44', **(self.network_kwargs or {}))(
+            image, grasp_params, train=train)
+    q_predicted = endpoints['predictions']
+    q_logits = endpoints['logits']
+    if q_logits.ndim > 1 and q_logits.shape[-1] == 1:
+      q_logits = jnp.squeeze(q_logits, -1)
+    # Megabatch outputs [B, A] flatten back to the caller's [B*A] layout.
+    outputs = SpecStruct(
+        q_predicted=q_predicted.reshape((-1,)),
+        q_logits=q_logits.reshape((-1,)))
+    outputs['pool2'] = endpoints['pool2']
+    outputs['final_conv'] = endpoints['final_conv']
+    return outputs
+
+
+class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
+  """The default Grasping44 image preprocessor (ref t2r_models.py:246-312).
+
+  On disk: 512x640 uint8 jpeg frames. For the model: 472x472 float32 in
+  [0, 1], randomly cropped + photometrically distorted in TRAIN, center
+  cropped otherwise. Pure JAX on device — XLA fuses the crop/convert/
+  distort chain into the input of conv1.
+  """
+
+  def update_spec_transform(self, key: str, spec: TensorSpec,
+                            mode: str) -> TensorSpec:
+    del mode
+    if key == 'state/image':
+      return TensorSpec.from_spec(
+          spec, shape=INPUT_SHAPE, dtype=np.uint8, data_format='jpeg')
+    return spec
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None):
+    image = jnp.asarray(features['state/image'], jnp.float32) / 255.0
+    if mode == ModeKeys.TRAIN:
+      if rng is None:
+        raise ValueError('TRAIN-mode preprocessing requires an rng key.')
+      crop_rng, distort_rng = jax.random.split(jnp.asarray(rng))
+      image = image_transformations.random_crop_images(
+          crop_rng, [image], TARGET_SHAPE)[0]
+      image = image_transformations.apply_photometric_image_distortions(
+          distort_rng, [image],
+          random_brightness=True, random_saturation=True, random_hue=True,
+          random_noise_level=0.05)[0]
+    else:
+      image = image_transformations.center_crop_images(
+          [image], TARGET_SHAPE)[0]
+    features['state/image'] = image
+    return features, labels
+
+
+class LegacyGraspingModelWrapper(CriticModel):
+  """T2R wrapper around the Grasping44 network family (ref :66-243).
+
+  Subclasses declare ``legacy_network_kwargs``/state/action specs; training
+  uses the legacy optimizer stack (momentum + staircase exponential decay +
+  parameter averaging) via ``optimizer_builder.build_opt``.
+  """
+
+  def __init__(self,
+               loss_function: Optional[Callable] = None,
+               learning_rate: float = 1e-4,
+               model_weights_averaging: float = 0.9999,
+               momentum: float = 0.9,
+               export_batch_size: int = 1,
+               use_avg_model_params: bool = True,
+               learning_rate_decay_factor: float = 0.999,
+               action_batch_size: Optional[int] = None,
+               preprocessor_cls=DefaultGrasping44ImagePreprocessor,
+               **kwargs):
+    """Hparam defaults mirror ref t2r_models.py:69-102."""
+    self.hparams = optimizer_builder.default_hparams(
+        learning_rate=learning_rate,
+        learning_rate_decay_factor=learning_rate_decay_factor,
+        model_weights_averaging=model_weights_averaging,
+        momentum=momentum,
+        use_avg_model_params=use_avg_model_params)
+    self._loss_function = loss_function
+    self._export_batch_size = export_batch_size
+    self._network_kwargs = dict(kwargs.pop('network_kwargs', {}))
+    super().__init__(
+        action_batch_size=action_batch_size,
+        preprocessor_cls=preprocessor_cls,
+        create_optimizer_fn=lambda: optimizer_builder.build_opt(self.hparams),
+        use_avg_model_params=use_avg_model_params,
+        avg_model_params_decay=model_weights_averaging,
+        **kwargs)
+
+  @property
+  def legacy_network_kwargs(self) -> dict:
+    """Constructor kwargs for Grasping44Network (ref legacy_model_class)."""
+    return dict(self._network_kwargs)
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    """ref :125-130 — grasp_success served as the 'reward' label."""
+    del mode
+    return SpecStruct(reward=TensorSpec(
+        (1,), np.float32, name='grasp_success'))
+
+  @property
+  def l2_regularization_scale(self) -> float:
+    return self.legacy_network_kwargs.get(
+        'l2_regularization', networks.Grasping44Network.l2_regularization)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """Grasp cross-entropy + l2 weight decay (ref :233-243).
+
+    The reference's tf.losses.get_total_loss() sums the log loss with slim's
+    REGULARIZATION_LOSSES; here both terms are explicit.
+    """
+    q_logits = inference_outputs['q_logits']
+    targets = jnp.asarray(labels[self.reward_key],
+                          jnp.float32).reshape(q_logits.shape)
+    if self._loss_function is not None:
+      grasp_loss = self._loss_function(
+          targets, inference_outputs[self.q_key])
+    else:
+      grasp_loss = jnp.mean(optax.sigmoid_binary_cross_entropy(
+          q_logits.astype(jnp.float32), targets))
+    l2_loss = networks.l2_regularization_loss(
+        variables['params'], self.l2_regularization_scale)
+    return grasp_loss + l2_loss, SpecStruct(grasp_loss=grasp_loss,
+                                            l2_loss=l2_loss)
+
+  def create_export_outputs_fn(self, features, inference_outputs, mode: str):
+    del features, mode
+    return SpecStruct(q_predicted=inference_outputs['q_predicted'],
+                      q_logits=inference_outputs['q_logits'])
+
+  def predict_step(self, state, features):
+    """No state tiling: the network runs the action megabatch internally
+    (image tower once per state; ref networks.py:520-527)."""
+    return abstract_model.AbstractT2RModel.predict_step(self, state, features)
+
+
+class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+    LegacyGraspingModelWrapper):
+  """The QT-Opt flagship critic (ref :316-404).
+
+  Controls gripper open/close/terminate with gripper status + height to
+  bottom carried in the state-conditioned action vector. The grasp-param
+  embedding uses the per-block dense layout of the reference E2E network
+  (networks.py:736-744).
+  """
+
+  def get_state_specification(self) -> SpecStruct:
+    """ref :336-339."""
+    return SpecStruct(image=TensorSpec(
+        TARGET_SHAPE + (3,), np.float32, name='image_1'))
+
+  def get_action_specification(self) -> SpecStruct:
+    """ref :341-364."""
+    spec = SpecStruct()
+    for key, size in ACTION_DIM_LAYOUT + (('gripper_closed', 1),
+                                          ('height_to_bottom', 1)):
+      spec[key] = TensorSpec((size,), np.float32, name=key)
+    return spec
+
+  def create_network(self) -> nn.Module:
+    return GraspingQNetwork(
+        grasp_param_keys=networks.E2E_GRASP_PARAM_KEYS,
+        grasp_param_names=networks.E2E_GRASP_PARAM_NAMES,
+        dtype=jnp.dtype(self.compute_dtype),
+        network_kwargs=self.legacy_network_kwargs or None)
+
+  def pack_features(self, *policy_inputs):
+    """ref :399-400."""
+    return pack_features_kuka_e2e(self, *policy_inputs)

@@ -8,6 +8,7 @@ filesystem actor↔learner transport of SURVEY.md §2.9.
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 from typing import Callable, Optional
@@ -53,11 +54,34 @@ def collect_eval_loop(collect_env,
   """
   if pre_collect_eval_fn:
     pre_collect_eval_fn()
-  run_agent_fn = run_agent_fn or run_env_lib.run_env
+  owns_envs = run_agent_fn is None
+  if owns_envs:
+    # The default run_env closes its env after every call (close_env=True),
+    # which would hand continuous-mode iteration 2 a closed env; keep envs
+    # open across versions and close them once on exit.
+    run_agent_fn = functools.partial(run_env_lib.run_env, close_env=False)
 
   collect_dir = os.path.join(root_dir, 'policy_collect')
   eval_dir = os.path.join(root_dir, 'eval')
 
+  try:
+    _collect_eval(collect_env, eval_env, policy_class, num_collect, num_eval,
+                  run_agent_fn, root_dir, continuous, min_collect_eval_step,
+                  max_steps, record_eval_env_video,
+                  init_with_random_variables, poll_sleep_secs,
+                  max_poll_attempts, collect_dir, eval_dir)
+  finally:
+    if owns_envs:
+      for env in (collect_env, eval_env):
+        if env is not None and hasattr(env, 'close'):
+          env.close()
+
+
+def _collect_eval(collect_env, eval_env, policy_class, num_collect, num_eval,
+                  run_agent_fn, root_dir, continuous, min_collect_eval_step,
+                  max_steps, record_eval_env_video,
+                  init_with_random_variables, poll_sleep_secs,
+                  max_poll_attempts, collect_dir, eval_dir) -> None:
   policy = policy_class()
   prev_global_step = -1
   attempts = 0

@@ -106,9 +106,17 @@ class Trainer:
     return sharding_lib.batch_sharding(self.mesh)
 
   def init_state(self, features: SpecStruct,
-                 labels: Optional[SpecStruct]) -> TrainState:
-    """Initializes (or restores) a sharded TrainState from a sample batch."""
+                 labels: Optional[SpecStruct],
+                 mode: str = ModeKeys.TRAIN) -> TrainState:
+    """Initializes (or restores) a sharded TrainState from a sample batch.
+
+    ``features``/``labels`` are an IN-spec batch from the input pipeline;
+    they are run through the preprocessor so variable shapes match what the
+    (preprocessed) train step feeds the network.
+    """
     rng = jax.random.PRNGKey(self.seed)
+    features, labels = self.model.preprocessor.preprocess(
+        features, labels, mode, rng=jax.random.PRNGKey(self.seed + 2))
     abstract_state = jax.eval_shape(
         lambda: self.model.create_train_state(rng, features, labels))
     self._state_sharding = sharding_lib.train_state_sharding(
@@ -149,9 +157,16 @@ class Trainer:
     def step(state, features, labels, base_rng):
       # Fold the step into the rng on-device: no host round-trip per step.
       rng = jax.random.fold_in(base_rng, state.step)
-      return model.train_step(state, SpecStruct(**features),
-                              SpecStruct(**labels) if labels is not None
-                              else None, rng)
+      pre_rng, step_rng = jax.random.split(rng)
+      # The preprocessor runs INSIDE the jitted step: crops/distortions/casts
+      # execute on device, fused by XLA into the forward pass (the TPU-native
+      # replacement for the reference's host-side tf.data map,
+      # utils/tfdata.py:572-574).
+      features, labels = model.preprocessor.preprocess(
+          SpecStruct(**features),
+          SpecStruct(**labels) if labels is not None else None,
+          ModeKeys.TRAIN, rng=pre_rng)
+      return model.train_step(state, features, labels, step_rng)
 
     batch = self._batch_sharding()
     replicated = NamedSharding(self.mesh, P())
@@ -169,15 +184,15 @@ class Trainer:
     use_avg = self.use_avg_params_for_eval
 
     def step(state, features, labels):
+      features, labels = model.preprocessor.preprocess(
+          SpecStruct(**features),
+          SpecStruct(**labels) if labels is not None else None,
+          ModeKeys.EVAL, rng=None)
       variables = state.variables(use_avg_params=use_avg)
       outputs, _ = model.inference_network_fn(
-          variables, SpecStruct(**features),
-          SpecStruct(**labels) if labels is not None else None,
-          ModeKeys.EVAL, None)
+          variables, features, labels, ModeKeys.EVAL, None)
       metrics = model.model_eval_fn(
-          variables, SpecStruct(**features),
-          SpecStruct(**labels) if labels is not None else None,
-          outputs, ModeKeys.EVAL)
+          variables, features, labels, outputs, ModeKeys.EVAL)
       return dict(metrics)
 
     batch = self._batch_sharding()
@@ -192,7 +207,9 @@ class Trainer:
     model = self.model
 
     def step(state, features):
-      outputs = model.predict_step(state, SpecStruct(**features))
+      features, _ = model.preprocessor.preprocess(
+          SpecStruct(**features), None, ModeKeys.PREDICT, rng=None)
+      outputs = model.predict_step(state, features)
       return dict(outputs)
 
     self._predict_step_fn = jax.jit(
@@ -270,7 +287,7 @@ class Trainer:
     batch = next(iterator)
     if state is None:
       # The init batch is still scored below — no data is skipped.
-      state = self.init_state(*batch)
+      state = self.init_state(*batch, mode=ModeKeys.EVAL)
     self.last_eval_state = state
     eval_fn = self._compile_eval_step()
     totals: Dict[str, float] = {}

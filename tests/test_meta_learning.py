@@ -76,7 +76,7 @@ class TestInnerLoop:
   def test_single_sgd_step_math(self):
     # loss = (w x - y)^2, dl/dw = 2 x (w x - y).
     # w0=1, x=2, y=0: grad = 2*2*2 = 8; w1 = 1 - 0.1*8 = 0.2.
-    (uncond, cond), inner_outputs, inner_losses = self._run(
+    (uncond, cond), inner_outputs, inner_losses, _ = self._run(
         w0=1.0, x=2.0, y=0.0, lr=0.1)
     np.testing.assert_allclose(uncond['inference_output'], [[2.0]], atol=1e-5)
     np.testing.assert_allclose(cond['inference_output'], [[0.4]], atol=1e-5)
@@ -95,7 +95,7 @@ class TestInnerLoop:
       features = SpecStruct(x=jnp.asarray([[x]], jnp.float32))
       labels = SpecStruct(target=jnp.asarray([[y]], jnp.float32))
       params = {'linear': {'kernel': jnp.asarray([[w0]], jnp.float32)}}
-      (_, cond), _, _ = inner.inner_loop(
+      (_, cond), _, _, _ = inner.inner_loop(
           params, {}, [(features, labels), (features, labels)],
           model.inference_network_fn, model.model_train_fn, ModeKeys.TRAIN)
       return jnp.mean((cond['inference_output'] - y) ** 2)
@@ -118,7 +118,7 @@ class TestInnerLoop:
     np.testing.assert_allclose(lrs['linear']['kernel'], 0.05)
 
   def test_var_scope_freezes_nonmatching(self):
-    (_, cond), _, _ = self._run(w0=1.0, x=2.0, y=0.0, lr=0.1,
+    (_, cond), _, _, _ = self._run(w0=1.0, x=2.0, y=0.0, lr=0.1,
                                 var_scope='some_other_scope')
     # Nothing adapts: conditioned == unconditioned.
     np.testing.assert_allclose(cond['inference_output'], [[2.0]], atol=1e-5)
