@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Config-driven collect/eval entry point (the robot-side job).
+
+Parity target: /root/reference/bin/run_collect_eval.py:44-51. Usage:
+
+    python bin/run_collect_eval.py \
+        --gin_configs my_collect_config.gin \
+        --gin_bindings "collect_eval_loop.root_dir = '/tmp/collect'"
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--gin_configs', action='append', default=[],
+                      help='Path to a gin config file (repeatable).')
+  parser.add_argument('--gin_bindings', action='append', default=[],
+                      help="Individual binding, e.g. \"a.b = 1\" (repeatable).")
+  args = parser.parse_args(argv)
+
+  from tensor2robot_tpu import config
+
+  config.register_framework_configurables()
+  config.add_config_file_search_path(
+      os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+  config.parse_config_files_and_bindings(args.gin_configs, args.gin_bindings)
+  collect_eval_loop = config.get_configurable('collect_eval_loop')
+  collect_eval_loop()
+
+
+if __name__ == '__main__':
+  main()

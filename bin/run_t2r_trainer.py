@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Config-driven trainer entry point.
+
+Parity target: /root/reference/bin/run_t2r_trainer.py:32-39. Usage:
+
+    python bin/run_t2r_trainer.py \
+        --gin_configs tensor2robot_tpu/research/pose_env/configs/train_pose_env.gin \
+        --gin_bindings "train_eval_model.model_dir = '/tmp/pose_run'" \
+        --gin_bindings "train_eval_model.max_train_steps = 100"
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--gin_configs', action='append', default=[],
+                      help='Path to a gin config file (repeatable).')
+  parser.add_argument('--gin_bindings', action='append', default=[],
+                      help="Individual binding, e.g. \"a.b = 1\" (repeatable).")
+  args = parser.parse_args(argv)
+
+  from tensor2robot_tpu import config
+
+  config.register_framework_configurables()
+  config.add_config_file_search_path(
+      os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+  config.parse_config_files_and_bindings(args.gin_configs, args.gin_bindings)
+  train_eval_model = config.get_configurable('train_eval_model')
+  results = train_eval_model()
+  metrics = results.get('eval_metrics') if isinstance(results, dict) else None
+  if metrics:
+    print('final eval metrics:', metrics)
+  return results
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,52 @@
+"""Configuration system: gin-file-compatible bindings for the framework.
+
+Use like gin (ref /root/reference/bin/run_t2r_trainer.py:33):
+
+    from tensor2robot_tpu import config
+    config.register_framework_configurables()
+    config.parse_config_files_and_bindings(['train_qtopt.gin'], bindings)
+    train_eval_model = config.get_configurable('train_eval_model')
+    results = train_eval_model(model_dir='/tmp/run')
+"""
+
+from tensor2robot_tpu.config.ginlike import (
+    ConfigError,
+    ConfigurableReference,
+    add_config_file_search_path,
+    clear_config,
+    config_str,
+    configurable,
+    external_configurable,
+    get_configurable,
+    operative_config_str,
+    parse_config,
+    parse_config_files_and_bindings,
+    query_parameter,
+)
+
+
+def register_framework_configurables() -> None:
+  """Registers the public framework + workload API (idempotent).
+
+  The reference decorates everything with @gin.configurable in-source;
+  here registration is centralized so library modules stay import-light.
+  """
+  from tensor2robot_tpu.config import registry
+  registry.register_all()
+
+
+__all__ = [
+    'ConfigError',
+    'ConfigurableReference',
+    'add_config_file_search_path',
+    'clear_config',
+    'config_str',
+    'configurable',
+    'external_configurable',
+    'get_configurable',
+    'operative_config_str',
+    'parse_config',
+    'parse_config_files_and_bindings',
+    'query_parameter',
+    'register_framework_configurables',
+]

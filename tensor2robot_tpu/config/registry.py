@@ -1,0 +1,164 @@
+"""Registers the framework's public API as configurables.
+
+The reference sprinkles @gin.configurable across every module
+(ref models/abstract_model.py:70-85, utils/train_eval.py:61); here the
+whole registration surface lives in one place so the config system stays
+optional and library modules import nothing from it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tensor2robot_tpu.config import ginlike
+
+_REGISTERED = False
+_LOCK = threading.Lock()
+
+
+def register_all() -> None:
+  global _REGISTERED
+  with _LOCK:
+    if _REGISTERED:
+      return
+    _REGISTERED = True
+
+  from tensor2robot_tpu import parallel
+  from tensor2robot_tpu.data import input_generators
+  from tensor2robot_tpu.export import exporters as exporters_lib
+  from tensor2robot_tpu.export import export_generators
+  from tensor2robot_tpu.hooks import async_export_hook_builder
+  from tensor2robot_tpu.hooks import td3
+  from tensor2robot_tpu.hooks import variable_logger_hook
+  from tensor2robot_tpu.meta_learning import maml_inner_loop
+  from tensor2robot_tpu.meta_learning import maml_model
+  from tensor2robot_tpu.meta_learning import meta_data
+  from tensor2robot_tpu.meta_learning import preprocessors as meta_preproc
+  from tensor2robot_tpu.models import optimizers
+  from tensor2robot_tpu.policies import policies
+  from tensor2robot_tpu.research.grasp2vec import grasp2vec_model
+  from tensor2robot_tpu.research.grasp2vec import losses as g2v_losses
+  from tensor2robot_tpu.research.pose_env import pose_env
+  from tensor2robot_tpu.research.pose_env import pose_env_maml_models
+  from tensor2robot_tpu.research.pose_env import pose_env_models
+  from tensor2robot_tpu.research.qtopt import networks as qtopt_networks
+  from tensor2robot_tpu.research.qtopt import optimizer_builder
+  from tensor2robot_tpu.research.qtopt import t2r_models as qtopt_models
+  from tensor2robot_tpu.research.vrgripper import decoders
+  from tensor2robot_tpu.research.vrgripper import vrgripper_env_models
+  from tensor2robot_tpu.research.vrgripper import vrgripper_env_meta_models
+  from tensor2robot_tpu.research.vrgripper import vrgripper_env_wtl_models
+  import importlib
+
+  from tensor2robot_tpu.rl import collect_eval
+  # rl/__init__ rebinds the name 'run_env' to the function, which shadows
+  # the submodule for attribute-style imports; go through importlib.
+  run_env_module = importlib.import_module('tensor2robot_tpu.rl.run_env')
+  from tensor2robot_tpu.trainer import train_eval
+
+  register = ginlike.external_configurable
+
+  # Trainer / harness (ref utils/train_eval.py:61).
+  register(train_eval.train_eval_model, 'train_eval_model')
+  register(train_eval.Trainer, 'Trainer')
+  register(parallel.create_mesh, 'create_mesh')
+  register(exporters_lib.create_default_exporters,
+           'create_default_exporters')
+  register(export_generators.DefaultExportGenerator,
+           'DefaultExportGenerator')
+  from tensor2robot_tpu.export import tf_savedmodel
+  register(tf_savedmodel.TFSavedModelExportGenerator,
+           'TFSavedModelExportGenerator')
+  register(async_export_hook_builder.AsyncExportHookBuilder,
+           'AsyncExportHookBuilder')
+  register(td3.TD3Hooks, 'TD3Hooks')
+  register(variable_logger_hook.VariableLoggerHook, 'VariableLoggerHook')
+
+  # Input generators (ref input_generators/default_input_generator.py).
+  register(input_generators.DefaultRecordInputGenerator,
+           'DefaultRecordInputGenerator')
+  register(input_generators.FractionalRecordInputGenerator,
+           'FractionalRecordInputGenerator')
+  register(input_generators.MultiEvalRecordInputGenerator,
+           'MultiEvalRecordInputGenerator')
+  register(input_generators.DefaultRandomInputGenerator,
+           'DefaultRandomInputGenerator')
+  register(input_generators.DefaultConstantInputGenerator,
+           'DefaultConstantInputGenerator')
+  register(meta_data.MetaRecordInputGenerator, 'MetaRecordInputGenerator')
+  register(meta_data.MAMLRandomInputGenerator, 'MAMLRandomInputGenerator')
+
+  # Optimizers (ref models/optimizers.py:29-52).
+  register(optimizers.create_adam_optimizer, 'create_adam_optimizer')
+  register(optimizers.create_sgd_optimizer, 'create_sgd_optimizer')
+  register(optimizers.create_momentum_optimizer,
+           'create_momentum_optimizer')
+  register(optimizers.create_rms_prop_optimizer,
+           'create_rms_prop_optimizer')
+  register(optimizers.create_constant_learning_rate,
+           'create_constant_learning_rate')
+  register(optimizers.create_exponential_decay_learning_rate,
+           'create_exponential_decay_learning_rate')
+
+  # Meta learning.
+  register(maml_model.MAMLRegressionModel, 'MAMLRegressionModel')
+  register(maml_inner_loop.MAMLInnerLoopGradientDescent,
+           'MAMLInnerLoopGradientDescent')
+  register(meta_preproc.MAMLPreprocessorV2, 'MAMLPreprocessorV2')
+  register(meta_preproc.FixedLenMetaExamplePreprocessor,
+           'FixedLenMetaExamplePreprocessor')
+
+  # Policies + collect/eval loop.
+  register(policies.CEMPolicy, 'CEMPolicy')
+  register(policies.RegressionPolicy, 'RegressionPolicy')
+  register(policies.OUExploreRegressionPolicy, 'OUExploreRegressionPolicy')
+  register(policies.ScheduledExplorationRegressionPolicy,
+           'ScheduledExplorationRegressionPolicy')
+  register(policies.PerEpisodeSwitchPolicy, 'PerEpisodeSwitchPolicy')
+  register(collect_eval.collect_eval_loop, 'collect_eval_loop')
+  register(run_env_module.run_env, 'run_env')
+
+  # QT-Opt workload (ref research/qtopt).
+  register(
+      qtopt_models.Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom,
+      'Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom')
+  register(qtopt_models.DefaultGrasping44ImagePreprocessor,
+           'DefaultGrasping44ImagePreprocessor')
+  register(optimizer_builder.build_opt, 'build_opt')
+  register(qtopt_networks.Grasping44Network, 'Grasping44Network')
+
+  # Grasp2Vec workload.
+  register(grasp2vec_model.Grasp2VecModel, 'Grasp2VecModel')
+  register(grasp2vec_model.Grasp2VecPreprocessor, 'Grasp2VecPreprocessor')
+  register(g2v_losses.n_pairs_loss, 'NPairsLoss')
+  register(g2v_losses.triplet_loss, 'TripletLoss')
+
+  # VRGripper / WTL workload.
+  register(vrgripper_env_models.VRGripperRegressionModel,
+           'VRGripperRegressionModel')
+  register(vrgripper_env_models.VRGripperDomainAdaptiveModel,
+           'VRGripperDomainAdaptiveModel')
+  register(vrgripper_env_models.DefaultVRGripperPreprocessor,
+           'DefaultVRGripperPreprocessor')
+  register(vrgripper_env_meta_models.VRGripperEnvRegressionModelMAML,
+           'VRGripperEnvRegressionModelMAML')
+  register(vrgripper_env_meta_models.VRGripperEnvTecModel,
+           'VRGripperEnvTecModel')
+  register(vrgripper_env_meta_models.VRGripperEnvSequentialModel,
+           'VRGripperEnvSequentialModel')
+  register(vrgripper_env_wtl_models.VRGripperEnvSimpleTrialModel,
+           'VRGripperEnvSimpleTrialModel')
+  register(vrgripper_env_wtl_models.VRGripperEnvVisionTrialModel,
+           'VRGripperEnvVisionTrialModel')
+  register(decoders.MSEDecoder, 'MSEDecoder')
+  register(decoders.MDNActionDecoder, 'MDNActionDecoder')
+  register(decoders.MAFDecoder, 'MAFDecoder')
+  register(decoders.DiscreteDecoder, 'DiscreteDecoder')
+
+  # Pose env workload.
+  register(pose_env.PoseToyEnv, 'PoseToyEnv')
+  register(pose_env_models.PoseEnvRegressionModel, 'PoseEnvRegressionModel')
+  register(pose_env_models.PoseEnvContinuousMCModel,
+           'PoseEnvContinuousMCModel')
+  register(pose_env_maml_models.PoseEnvRegressionModelMAML,
+           'PoseEnvRegressionModelMAML')

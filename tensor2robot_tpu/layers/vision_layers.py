@@ -188,10 +188,8 @@ class ImageFeaturesToPoseNet(nn.Module):
       net = nn.Dense(width)(net)
       net = nn.LayerNorm()(net)
       net = nn.relu(net)
-    if self.num_outputs is None:
-      return net
-    pose = nn.Dense(self.num_outputs)(net)
+    output = net if self.num_outputs is None else nn.Dense(
+        self.num_outputs)(net)
     if self.aux_output_dim:
-      aux_pred = nn.Dense(self.aux_output_dim, name='aux_dense')(net)
-      return pose, aux_pred
-    return pose
+      return output, nn.Dense(self.aux_output_dim, name='aux_dense')(net)
+    return output

@@ -127,6 +127,12 @@ class MAMLModel(AbstractT2RModel):
     # when the outer labels are absent (predict mode, ref :298-300).
     val_l = dict(labels) if labels is not None and len(labels) else cond_l
 
+    # Domain-adaptive base models (e.g. DAML's learned loss) can declare a
+    # dedicated inner-loop objective; the outer loss still uses
+    # model_train_fn (ref vrgripper_env_models.py:414-448 is_outer_loss).
+    inner_loss_fn = (getattr(self._base_model, 'inner_loop_loss_fn', None)
+                     or self._base_model.model_train_fn)
+
     def task_learn(task_cond_f, task_cond_l, task_inf_f, task_val_l):
       inputs_list = ([(SpecStruct(**task_cond_f), SpecStruct(**task_cond_l))]
                      * self._num_inner_loop_steps +
@@ -134,7 +140,7 @@ class MAMLModel(AbstractT2RModel):
       return self._inner_loop.inner_loop(
           base_params, model_state, inputs_list,
           self._base_model.inference_network_fn,
-          self._base_model.model_train_fn, mode, inner_lrs=inner_lrs,
+          inner_loss_fn, mode, inner_lrs=inner_lrs,
           rng=rng)
 
     (outputs, inner_outputs, inner_losses, new_model_state) = jax.vmap(

@@ -24,8 +24,14 @@ from tensor2robot_tpu.specs.struct import SpecStruct
 
 
 def flatten_batch_examples(struct):
-  """[num_tasks, num_samples, ...] -> [num_tasks * num_samples, ...] (ref :179)."""
+  """[num_tasks, num_samples, ...] -> [num_tasks * num_samples, ...] (ref :179).
+
+  Leaves without both leading dims (per-task scalars such as aux losses)
+  pass through unchanged.
+  """
   def _merge(x):
+    if getattr(x, 'ndim', 0) < 2:
+      return x
     return x.reshape((x.shape[0] * x.shape[1],) + tuple(x.shape[2:]))
   if isinstance(struct, (dict, SpecStruct)):
     return SpecStruct(**{k: _merge(struct[k]) for k in struct})
@@ -93,9 +99,9 @@ def split_meta_in_spec(meta_in_spec):
     name = spec.name
     if name and name.startswith(('condition_features/', 'condition_labels/')):
       name = name.split('/', 1)[1]
-    shape = spec.shape
-    if shape and shape[0] is None:
-      shape = shape[1:]  # the unknown samples dim added by the meta spec
+    # The meta spec always prepends exactly one samples dim (unknown for
+    # MAMLPreprocessorV2, fixed for the FixedLen layout) — strip it.
+    shape = spec.shape[1:] if spec.shape else spec.shape
     return TensorSpec.from_spec(spec, name=name, shape=shape)
 
   feature_spec, label_spec = SpecStruct(), SpecStruct()

@@ -31,29 +31,40 @@ from tensor2robot_tpu.specs.algebra import (
 from tensor2robot_tpu.specs.struct import SpecStruct
 
 
-def create_maml_feature_spec(feature_spec, label_spec) -> SpecStruct:
+def create_maml_feature_spec(feature_spec, label_spec,
+                             num_condition_samples_per_task: int = -1,
+                             num_inference_samples_per_task: int = -1
+                             ) -> SpecStruct:
   """Base feature+label specs -> meta feature spec (ref :39).
 
   Condition keeps the base names (so record parsing maps 1:1); specs gain a
-  leading unknown samples dim (the reference's batch_size=-1).
+  leading samples dim — unknown by default (the reference's batch_size=-1),
+  or fixed when sample counts are given (the FixedLenMetaExample layout,
+  ref preprocessors.py:346).
   """
   meta = SpecStruct()
   for key, spec in copy_tensorspec(
-      feature_spec, batch_size=-1, prefix='condition_features').items():
+      feature_spec, batch_size=num_condition_samples_per_task,
+      prefix='condition_features').items():
     meta['condition/features/' + key] = spec
   for key, spec in copy_tensorspec(
-      label_spec, batch_size=-1, prefix='condition_labels').items():
+      label_spec, batch_size=num_condition_samples_per_task,
+      prefix='condition_labels').items():
     meta['condition/labels/' + key] = spec
   for key, spec in copy_tensorspec(
-      feature_spec, batch_size=-1, prefix='inference_features').items():
+      feature_spec, batch_size=num_inference_samples_per_task,
+      prefix='inference_features').items():
     meta['inference/features/' + key] = spec
   return meta
 
 
-def create_maml_label_spec(label_spec) -> SpecStruct:
+def create_maml_label_spec(label_spec,
+                           num_inference_samples_per_task: int = -1
+                           ) -> SpecStruct:
   """Base label spec -> outer-loss label spec (ref :74)."""
   return flatten_spec_structure(
-      copy_tensorspec(label_spec, batch_size=-1, prefix='meta_labels'))
+      copy_tensorspec(label_spec, batch_size=num_inference_samples_per_task,
+                      prefix='meta_labels'))
 
 
 class MAMLPreprocessorV2(AbstractPreprocessor):
@@ -124,3 +135,53 @@ class MAMLPreprocessorV2(AbstractPreprocessor):
       out['inference/features/' + key] = inf_f[key]
     return out, (SpecStruct(**out_labels) if labels is not None and out_labels
                  else None)
+
+
+class FixedLenMetaExamplePreprocessor(MAMLPreprocessorV2):
+  """Meta preprocessor with FIXED condition/inference sample counts.
+
+  Parity: /root/reference/meta_learning/preprocessors.py:346
+  (FixedLenMetaExamplePreprocessor). Standalone meta models (TEC, WTL
+  trial/retrial) consume the meta layout directly with known episode
+  counts, so their specs carry concrete sample dims instead of the
+  MAMLPreprocessorV2's unknown dim.
+  """
+
+  def __init__(self, base_preprocessor: AbstractPreprocessor,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1):
+    super().__init__(base_preprocessor)
+    self._num_condition_samples_per_task = num_condition_samples_per_task
+    self._num_inference_samples_per_task = num_inference_samples_per_task
+
+  @property
+  def num_condition_samples_per_task(self) -> int:
+    return self._num_condition_samples_per_task
+
+  @property
+  def num_inference_samples_per_task(self) -> int:
+    return self._num_inference_samples_per_task
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    return create_maml_feature_spec(
+        self._base_preprocessor.get_in_feature_specification(mode),
+        self._base_preprocessor.get_in_label_specification(mode),
+        self._num_condition_samples_per_task,
+        self._num_inference_samples_per_task)
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return create_maml_label_spec(
+        self._base_preprocessor.get_in_label_specification(mode),
+        self._num_inference_samples_per_task)
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return create_maml_feature_spec(
+        self._base_preprocessor.get_out_feature_specification(mode),
+        self._base_preprocessor.get_out_label_specification(mode),
+        self._num_condition_samples_per_task,
+        self._num_inference_samples_per_task)
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return create_maml_label_spec(
+        self._base_preprocessor.get_out_label_specification(mode),
+        self._num_inference_samples_per_task)

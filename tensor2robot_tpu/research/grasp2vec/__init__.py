@@ -1,0 +1,19 @@
+"""Grasp2Vec: self-supervised object embeddings (arXiv:1811.06964)."""
+
+from tensor2robot_tpu.research.grasp2vec import losses
+from tensor2robot_tpu.research.grasp2vec import visualization
+from tensor2robot_tpu.research.grasp2vec.grasp2vec_model import (
+    EmbeddingNet,
+    Grasp2VecModel,
+    Grasp2VecPreprocessor,
+    maybe_crop_images,
+)
+
+__all__ = [
+    'EmbeddingNet',
+    'Grasp2VecModel',
+    'Grasp2VecPreprocessor',
+    'losses',
+    'maybe_crop_images',
+    'visualization',
+]

@@ -1,0 +1,219 @@
+"""Grasp2Vec model: self-supervised object embeddings (arXiv:1811.06964).
+
+Parity target: /root/reference/research/grasp2vec/grasp2vec_model.py
+(maybe_crop_images :49, Grasp2VecPreprocessor :81, Grasp2VecModel :141) and
+networks.py:27-45 (ResNet-50 spatial embedding). The embedding property:
+phi(pregrasp) - phi(postgrasp) ~= phi(goal).
+
+TPU-first notes: the pregrasp/postgrasp scene batches are concatenated so
+the ResNet-50 tower sees one doubled batch (one MXU-saturating pass, ref
+:192-194); all image preprocessing (shared random crop, flips, uint8->f32)
+runs inside the jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import resnet as resnet_lib
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors.spec_transformation_preprocessor import (
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.research.grasp2vec import losses
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+CropParams = Tuple[int, int, int, int, int, int]
+_IMAGE_KEYS = ('pregrasp_image', 'postgrasp_image', 'goal_image')
+
+
+def maybe_crop_images(key: Optional[jax.Array], images, params: CropParams,
+                      mode: str):
+  """Crops every batch in ``images`` at one shared offset (ref :49-77).
+
+  TRAIN samples the offset uniformly from the configured window; other
+  modes use the window center. Offsets are traced scalars — the crop is a
+  dynamic_slice with static target size, XLA-friendly.
+  """
+  (min_oh, max_oh, target_h, min_ow, max_ow, target_w) = params
+  if mode == ModeKeys.TRAIN:
+    if key is None:
+      raise ValueError('TRAIN-mode cropping requires an rng key.')
+    kh, kw = jax.random.split(key)
+    offset_h = jax.random.randint(kh, (), min_oh, max(max_oh, min_oh + 1))
+    offset_w = jax.random.randint(kw, (), min_ow, max(max_ow, min_ow + 1))
+  else:
+    offset_h = jnp.asarray((min_oh + max_oh) // 2)
+    offset_w = jnp.asarray((min_ow + max_ow) // 2)
+
+  def _crop(batch):
+    return jax.lax.dynamic_slice(
+        batch, (0, offset_h, offset_w, 0),
+        (batch.shape[0], target_h, target_w, batch.shape[3]))
+
+  return [_crop(img) for img in images], offset_h, offset_w
+
+
+class Grasp2VecPreprocessor(SpecTransformationPreprocessor):
+  """512x640 uint8 jpegs -> shared-crop, flipped float32 (ref :81-137)."""
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None,
+               scene_crop: CropParams = (0, 40, 472, 0, 168, 472),
+               goal_crop: CropParams = (0, 40, 472, 0, 168, 472),
+               src_img_shape: Tuple[int, int, int] = (512, 640, 3)):
+    super().__init__(model_feature_specification_fn,
+                     model_label_specification_fn)
+    self._scene_crop = tuple(scene_crop)
+    self._goal_crop = tuple(goal_crop)
+    self._src_img_shape = tuple(src_img_shape)
+
+  def update_spec_transform(self, key: str, spec: TensorSpec,
+                            mode: str) -> TensorSpec:
+    del mode
+    if key in _IMAGE_KEYS:
+      return TensorSpec.from_spec(spec, shape=self._src_img_shape,
+                                  dtype=np.uint8, data_format='jpeg')
+    return spec
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None):
+    rngs = (jax.random.split(jnp.asarray(rng), 4) if rng is not None
+            else [None] * 4)
+    scene_images, _, _ = maybe_crop_images(
+        rngs[0], [jnp.asarray(features['pregrasp_image']),
+                  jnp.asarray(features['postgrasp_image'])],
+        self._scene_crop, mode)
+    goal_images, _, _ = maybe_crop_images(
+        rngs[1], [jnp.asarray(features['goal_image'])], self._goal_crop,
+        mode)
+    images = dict(zip(_IMAGE_KEYS,
+                      [scene_images[0], scene_images[1], goal_images[0]]))
+    for idx, (name, image) in enumerate(images.items()):
+      image = jnp.asarray(image, jnp.float32) / 255.0
+      if mode == ModeKeys.TRAIN:
+        # Per-image random flips (ref :133-135), one coin per example.
+        flip_rng = jax.random.fold_in(rngs[2], idx)
+        klr, kud = jax.random.split(flip_rng)
+        batch = image.shape[0]
+        flip_lr = jax.random.bernoulli(klr, shape=(batch, 1, 1, 1))
+        flip_ud = jax.random.bernoulli(kud, shape=(batch, 1, 1, 1))
+        image = jnp.where(flip_lr, image[:, :, ::-1, :], image)
+        image = jnp.where(flip_ud, image[:, ::-1, :, :], image)
+      features[name] = image
+    return features, labels
+
+
+class EmbeddingNet(nn.Module):
+  """ResNet-50 spatial embedding tower (ref networks.py:27-45).
+
+  Returns (mean-pooled embedding [B, D], relu spatial map [B, h, w, D]).
+  """
+
+  resnet_size: int = 50
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, image, train: bool = False):
+    _, endpoints = resnet_lib.ResNet(
+        resnet_size=self.resnet_size, dtype=self.dtype, name='resnet')(
+            image, train=train, include_head=False)
+    spatial = nn.relu(endpoints['pre_final_pool'])
+    summed = jnp.mean(spatial, axis=(1, 2))
+    return (jnp.asarray(summed, jnp.float32),
+            jnp.asarray(spatial, jnp.float32))
+
+
+class _Grasp2VecNet(nn.Module):
+  """Scene + goal towers over the feature struct (ref :185-208)."""
+
+  resnet_size: int = 50
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, features, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    # One doubled batch through the scene tower (ref :192-194).
+    scene_images = jnp.concatenate(
+        [jnp.asarray(features['pregrasp_image'], self.dtype),
+         jnp.asarray(features['postgrasp_image'], self.dtype)], axis=0)
+    scene_tower = EmbeddingNet(resnet_size=self.resnet_size,
+                               dtype=self.dtype, name='scene')
+    v, s = scene_tower(scene_images, train=train)
+    pre_v, post_v = jnp.split(v, 2, axis=0)
+    pre_s, post_s = jnp.split(s, 2, axis=0)
+    goal_v, goal_s = EmbeddingNet(resnet_size=self.resnet_size,
+                                  dtype=self.dtype, name='goal')(
+        jnp.asarray(features['goal_image'], self.dtype), train=train)
+    return SpecStruct(
+        pre_vector=pre_v, post_vector=post_v,
+        pre_spatial=pre_s, post_spatial=post_s,
+        goal_vector=goal_v, goal_spatial=goal_s)
+
+
+class Grasp2VecModel(AbstractT2RModel):
+  """Grasp2Vec embedding model (ref :141-245)."""
+
+  def __init__(self,
+               scene_size: Tuple[int, int] = (472, 472),
+               goal_size: Tuple[int, int] = (472, 472),
+               embedding_loss_fn: Callable = losses.n_pairs_loss,
+               resnet_size: int = 50,
+               preprocessor_cls=Grasp2VecPreprocessor,
+               **kwargs):
+    """Args mirror ref :144-160; embedding_loss_fn is n_pairs_loss or
+    the triplet variant (losses.py)."""
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
+    self._scene_size = tuple(scene_size)
+    self._goal_size = tuple(goal_size)
+    self._embedding_loss_fn = embedding_loss_fn
+    self._resnet_size = resnet_size
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    """ref :162-174 (on-disk names image/postgrasp_image/present_image)."""
+    del mode
+    return SpecStruct(
+        pregrasp_image=TensorSpec(self._scene_size + (3,), np.float32,
+                                  name='image', data_format='jpeg'),
+        postgrasp_image=TensorSpec(self._scene_size + (3,), np.float32,
+                                   name='postgrasp_image',
+                                   data_format='jpeg'),
+        goal_image=TensorSpec(self._goal_size + (3,), np.float32,
+                              name='present_image', data_format='jpeg'))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    """Grasp2Vec is self-supervised: no labels (ref :176-179)."""
+    del mode
+    return SpecStruct()
+
+  def create_network(self) -> nn.Module:
+    return _Grasp2VecNet(resnet_size=self._resnet_size,
+                         dtype=jnp.dtype(self.compute_dtype))
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """ref :210-222."""
+    embed_loss = self._embedding_loss_fn(
+        inference_outputs['pre_vector'],
+        inference_outputs['goal_vector'],
+        inference_outputs['post_vector'])
+    if isinstance(embed_loss, tuple):  # triplet_loss returns (loss, ...)
+      embed_loss = embed_loss[0]
+    return embed_loss, SpecStruct(embed_loss=embed_loss)
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, train_outputs = self.model_train_fn(
+        variables, features, labels, inference_outputs, mode)
+    metrics = SpecStruct(loss=loss)
+    for key in train_outputs:
+      metrics[key] = train_outputs[key]
+    return metrics

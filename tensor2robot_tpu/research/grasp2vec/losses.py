@@ -1,0 +1,257 @@
+"""Grasp2Vec embedding losses (arXiv:1811.06964).
+
+Parity target: /root/reference/research/grasp2vec/losses.py:34-308. The
+tf-slim metric-learning primitives the reference calls are implemented
+natively:
+
+  * ``npairs_loss``      — softmax cross entropy over the similarity matrix
+                           with row-normalized label-equality targets plus
+                           the 0.25 * reg_lambda * mean||e||^2 regularizer
+                           (slim metric_learning.npairs_loss semantics).
+  * ``triplet_semihard_loss`` — semi-hard negative mining over the pairwise
+                           distance matrix (slim triplet_semihard_loss).
+
+Masked variants replace tf.dynamic_partition/tf.cond with arithmetic
+masking — identical values, no data-dependent control flow, so the losses
+jit cleanly on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+  """Mean over mask==1 entries; exact 0.0 when the mask is empty (ref tf.cond)."""
+  mask = jnp.asarray(mask, jnp.float32).reshape(values.shape)
+  total = jnp.sum(mask)
+  return jnp.where(total > 0, jnp.sum(values * mask) / jnp.maximum(total, 1.0),
+                   0.0)
+
+
+def l2_arithmetic_loss(pregrasp_embedding, goal_embedding,
+                       postgrasp_embedding, mask) -> jnp.ndarray:
+  """mean ||pre - goal - post||^2 over masked rows (ref :34-57)."""
+  raw = (jnp.asarray(pregrasp_embedding, jnp.float32) -
+         jnp.asarray(goal_embedding, jnp.float32) -
+         jnp.asarray(postgrasp_embedding, jnp.float32))
+  distances = jnp.sum(raw ** 2, axis=1)
+  return _masked_mean(distances, mask)
+
+
+def cosine_arithmetic_loss(pregrasp_embedding, goal_embedding,
+                           postgrasp_embedding, mask) -> jnp.ndarray:
+  """Masked mean cosine distance of (pre - post) vs goal (ref :85-112)."""
+  pair_a = _l2_normalize(
+      jnp.asarray(pregrasp_embedding, jnp.float32) -
+      jnp.asarray(postgrasp_embedding, jnp.float32))
+  pair_b = _l2_normalize(jnp.asarray(goal_embedding, jnp.float32))
+  distances = 1.0 - jnp.sum(pair_a * pair_b, axis=1)
+  return _masked_mean(distances, mask)
+
+
+def send_to_zero_loss(tensor, mask) -> jnp.ndarray:
+  """Masked mean L2 norm (ref :143-161)."""
+  distances = jnp.linalg.norm(jnp.asarray(tensor, jnp.float32), axis=1)
+  return _masked_mean(distances, mask)
+
+
+def _l2_normalize(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+  return x / jnp.maximum(jnp.linalg.norm(x, axis=axis, keepdims=True), 1e-12)
+
+
+def npairs_loss(labels: jnp.ndarray, embeddings_anchor: jnp.ndarray,
+                embeddings_positive: jnp.ndarray,
+                reg_lambda: float = 0.002) -> jnp.ndarray:
+  """slim metric_learning.npairs_loss semantics.
+
+  xent(similarity_matrix, row-normalized label equality) +
+  reg_lambda * 0.25 * (mean||a||^2 + mean||b||^2).
+  """
+  anchor = jnp.asarray(embeddings_anchor, jnp.float32)
+  positive = jnp.asarray(embeddings_positive, jnp.float32)
+  reg_anchor = jnp.mean(jnp.sum(anchor ** 2, axis=1))
+  reg_positive = jnp.mean(jnp.sum(positive ** 2, axis=1))
+  l2loss = 0.25 * reg_lambda * (reg_anchor + reg_positive)
+  similarity = anchor @ positive.T
+  labels = jnp.asarray(labels)
+  labels_equal = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+  labels_remapped = labels_equal / jnp.sum(labels_equal, axis=1,
+                                           keepdims=True)
+  xent = -jnp.sum(labels_remapped * jax.nn.log_softmax(similarity, axis=1),
+                  axis=1)
+  return jnp.mean(xent) + l2loss
+
+
+def npairs_loss_multilabel(multilabels: jnp.ndarray,
+                           embeddings_anchor: jnp.ndarray,
+                           embeddings_positive: jnp.ndarray,
+                           reg_lambda: float = 0.002) -> jnp.ndarray:
+  """slim npairs_loss_multilabel with DENSE multilabel one-hots.
+
+  ``multilabels``: [batch, num_classes] {0,1}; label similarity is the
+  Jaccard-style normalized intersection slim computes from sparse labels.
+  """
+  anchor = jnp.asarray(embeddings_anchor, jnp.float32)
+  positive = jnp.asarray(embeddings_positive, jnp.float32)
+  reg_anchor = jnp.mean(jnp.sum(anchor ** 2, axis=1))
+  reg_positive = jnp.mean(jnp.sum(positive ** 2, axis=1))
+  l2loss = 0.25 * reg_lambda * (reg_anchor + reg_positive)
+  multilabels = jnp.asarray(multilabels, jnp.float32)
+  intersection = multilabels @ multilabels.T
+  labels_remapped = intersection / jnp.maximum(
+      jnp.sum(intersection, axis=1, keepdims=True), 1e-12)
+  similarity = anchor @ positive.T
+  xent = -jnp.sum(labels_remapped * jax.nn.log_softmax(similarity, axis=1),
+                  axis=1)
+  return jnp.mean(xent) + l2loss
+
+
+def n_pairs_loss(pregrasp_embedding, goal_embedding, postgrasp_embedding,
+                 non_negativity_constraint: bool = False) -> jnp.ndarray:
+  """Bidirectional npairs on (pre - post, goal) (ref NPairsLoss :164-190)."""
+  pair_a = (jnp.asarray(pregrasp_embedding, jnp.float32) -
+            jnp.asarray(postgrasp_embedding, jnp.float32))
+  if non_negativity_constraint:
+    pair_a = jax.nn.relu(pair_a)
+  pair_b = jnp.asarray(goal_embedding, jnp.float32)
+  labels = jnp.arange(pair_a.shape[0])
+  return (npairs_loss(labels, pair_a, pair_b) +
+          npairs_loss(labels, pair_b, pair_a))
+
+
+def n_pairs_loss_multilabel(pregrasp_embedding, goal_embedding,
+                            postgrasp_embedding, grasp_success
+                            ) -> jnp.ndarray:
+  """ref NPairsLossMultilabel :193-224: failed grasps share label 0."""
+  pair_a = (jnp.asarray(pregrasp_embedding, jnp.float32) -
+            jnp.asarray(postgrasp_embedding, jnp.float32))
+  pair_b = jnp.asarray(goal_embedding, jnp.float32)
+  batch = pair_a.shape[0]
+  grasp_success = jnp.asarray(grasp_success).reshape(batch).astype(jnp.int32)
+  range_tensor = jnp.arange(batch, dtype=jnp.int32) * grasp_success
+  multilabels = jax.nn.one_hot(range_tensor, batch + 1)
+  return (npairs_loss_multilabel(multilabels, pair_a, pair_b) +
+          npairs_loss_multilabel(multilabels, pair_b, pair_a))
+
+
+def _pairwise_squared_distances(a: jnp.ndarray) -> jnp.ndarray:
+  sq = jnp.sum(a ** 2, axis=1)
+  d = sq[:, None] - 2.0 * (a @ a.T) + sq[None, :]
+  return jnp.maximum(d, 0.0)
+
+
+def _masked_minimum(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+  """Row-wise min over mask==1 entries (slim masked_minimum)."""
+  axis_max = jnp.max(data, axis=1, keepdims=True)
+  return jnp.min((data - axis_max) * mask, axis=1, keepdims=True) + axis_max
+
+
+def _masked_maximum(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+  """Row-wise max over mask==1 entries (slim masked_maximum)."""
+  axis_min = jnp.min(data, axis=1, keepdims=True)
+  return jnp.max((data - axis_min) * mask, axis=1, keepdims=True) + axis_min
+
+
+def triplet_semihard_loss(labels: jnp.ndarray, embeddings: jnp.ndarray,
+                          margin: float = 1.0) -> jnp.ndarray:
+  """slim metric_learning.triplet_semihard_loss, faithfully.
+
+  For each positive pair (i, j): the negative is the closest one farther
+  than d(i, j) if such exists (semi-hard), else the farthest negative.
+  Loss = sum over positive pairs of relu(margin + d_ij - d_in) / count.
+  """
+  labels = jnp.asarray(labels).reshape(-1)
+  embeddings = jnp.asarray(embeddings, jnp.float32)
+  batch = embeddings.shape[0]
+  pdist = _pairwise_squared_distances(embeddings)
+  adjacency = (labels[:, None] == labels[None, :])
+  adjacency_not = (~adjacency).astype(jnp.float32)
+
+  # Row r = j*batch + i of the tiled matrix holds d(i, k) compared against
+  # d(i, j) — negatives of anchor i farther than its positive j.
+  pdist_tile = jnp.tile(pdist, (batch, 1))
+  mask = jnp.tile(adjacency_not, (batch, 1)) * (
+      pdist_tile > pdist.T.reshape(-1, 1)).astype(jnp.float32)
+  mask_final = (jnp.sum(mask, axis=1, keepdims=True) > 0.0).reshape(
+      batch, batch).T
+
+  negatives_outside = _masked_minimum(pdist_tile, mask).reshape(
+      batch, batch).T
+  negatives_inside = jnp.tile(_masked_maximum(pdist, adjacency_not),
+                              (1, batch))
+  semi_hard_negatives = jnp.where(mask_final, negatives_outside,
+                                  negatives_inside)
+  loss_mat = margin + pdist - semi_hard_negatives
+
+  mask_positives = adjacency.astype(jnp.float32) - jnp.eye(batch)
+  num_positives = jnp.maximum(jnp.sum(mask_positives), 1e-16)
+  return jnp.sum(jnp.maximum(loss_mat * mask_positives, 0.0)) / num_positives
+
+
+def triplet_loss(pregrasp_embedding, goal_embedding, postgrasp_embedding,
+                 margin: float = 3.0
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+  """Semi-hard triplet on normalized (pre-post, goal) pairs (ref :61-82)."""
+  pair_a = _l2_normalize(
+      jnp.asarray(pregrasp_embedding, jnp.float32) -
+      jnp.asarray(postgrasp_embedding, jnp.float32), axis=1)
+  pair_b = _l2_normalize(jnp.asarray(goal_embedding, jnp.float32), axis=1)
+  labels = jnp.tile(jnp.arange(pair_a.shape[0]), (2,))
+  pairs = jnp.concatenate([pair_a, pair_b], axis=0)
+  loss = triplet_semihard_loss(labels, pairs, margin=margin)
+  return loss, pairs, labels
+
+
+def match_norms_loss(anchor_tensors, paired_tensors) -> jnp.ndarray:
+  """Pushes paired norms toward (stop-gradient) anchor norms (ref :227-243)."""
+  anchor_norms = jax.lax.stop_gradient(
+      jnp.linalg.norm(jnp.asarray(anchor_tensors, jnp.float32), axis=1))
+  paired_norms = jnp.linalg.norm(
+      jnp.asarray(paired_tensors, jnp.float32), axis=1)
+  return jnp.mean(0.5 * (anchor_norms - paired_norms) ** 2)
+
+
+def keypoint_accuracy(keypoints, labels) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Quadrant accuracy of spatial-softmax keypoints (ref :115-140)."""
+  keypoints = jnp.asarray(keypoints, jnp.float32).reshape(-1, 2)
+  quadrant_centers = jnp.asarray(
+      [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]], jnp.float32)
+  logits = keypoints @ quadrant_centers.T
+  labels = jnp.asarray(labels).reshape(-1)
+  correct = (labels == jnp.argmax(logits, axis=1)).astype(jnp.float32)
+  labels_onehot = jax.nn.one_hot(labels, 4)
+  loss = jnp.mean(
+      jnp.maximum(logits, 0) - logits * labels_onehot +
+      jnp.log1p(jnp.exp(-jnp.abs(logits))))
+  return jnp.mean(correct), loss
+
+
+def get_softmax_response(goal_embedding, scene_spatial
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+  """Max heatmap response of a goal embedding in a scene (ref :246-271)."""
+  batch, dim = goal_embedding.shape
+  query = jnp.asarray(goal_embedding, jnp.float32).reshape(batch, 1, 1, dim)
+  heatmap = jnp.sum(jnp.asarray(scene_spatial, jnp.float32) * query, axis=3)
+  flat = heatmap.reshape(batch, -1)
+  max_heat = jnp.max(flat, axis=1)
+  max_soft = jnp.max(jax.nn.softmax(flat, axis=1), axis=1)
+  return max_heat, max_soft
+
+
+def ty_loss(pregrasp_spatial, postgrasp_spatial, goal_embedding
+            ) -> jnp.ndarray:
+  """Likelihood-ratio detection loss (ref TYloss :274-308)."""
+  pregrasp_spatial = _l2_normalize(
+      jnp.asarray(pregrasp_spatial, jnp.float32))
+  postgrasp_spatial = _l2_normalize(
+      jnp.asarray(postgrasp_spatial, jnp.float32))
+  goal = _l2_normalize(jnp.asarray(goal_embedding, jnp.float32))
+  goal = goal[:, None, None, :]
+  pre_max = jnp.max(jnp.sum(pregrasp_spatial * goal, axis=-1), axis=(1, 2))
+  post_max = jnp.max(jnp.sum(postgrasp_spatial * goal, axis=-1), axis=(1, 2))
+  return jnp.mean(post_max - pre_max)

@@ -1,0 +1,128 @@
+"""Grasp2Vec heatmap / keypoint visualization.
+
+Parity target: /root/reference/research/grasp2vec/visualization.py:39-249.
+The reference emits tf.summary images/histograms as a graph side effect;
+here each helper is a pure function returning arrays, and
+``grasp2vec_summaries`` packages them as a {name: array} dict the metrics
+writer (trainer/metrics.py) logs as images/histograms.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def compute_heatmaps(feature_query, feature_map) -> jnp.ndarray:
+  """Dot product of a query embedding across a spatial map (ref :81-102).
+
+  Args:
+    feature_query: [B, D] goal embeddings.
+    feature_map: [B, h, w, D] scene spatial embeddings.
+  Returns:
+    [B, h, w, 1] heatmaps.
+  """
+  batch, dim = feature_query.shape
+  query = jnp.asarray(feature_query, jnp.float32).reshape(batch, 1, 1, dim)
+  return jnp.sum(jnp.asarray(feature_map, jnp.float32) * query, axis=3,
+                 keepdims=True)
+
+
+def softmax_heatmaps(heatmaps: jnp.ndarray) -> jnp.ndarray:
+  """Spatially softmaxed heatmaps, same shape (ref :96-100)."""
+  batch = heatmaps.shape[0]
+  flat = jax.nn.softmax(heatmaps.reshape(batch, -1), axis=1)
+  return flat.reshape(heatmaps.shape)
+
+
+def heatmap_spatial_soft_argmax(heatmaps: jnp.ndarray,
+                                temperature: float = 0.1) -> jnp.ndarray:
+  """Expected (x, y) of the softmaxed heatmap in [-1, 1] (ref :105-115)."""
+  batch, height, width, _ = heatmaps.shape
+  probs = jax.nn.softmax(
+      heatmaps.reshape(batch, -1) / temperature, axis=1).reshape(
+          batch, height, width)
+  ys = jnp.linspace(-1.0, 1.0, height)
+  xs = jnp.linspace(-1.0, 1.0, width)
+  expected_y = jnp.sum(probs * ys[None, :, None], axis=(1, 2))
+  expected_x = jnp.sum(probs * xs[None, None, :], axis=(1, 2))
+  return jnp.stack([expected_x, expected_y], axis=-1)[:, None, :]
+
+
+def np_render_keypoints(image: np.ndarray, locations: np.ndarray,
+                        num_images: int = 3, dot_radius: int = 3
+                        ) -> np.ndarray:
+  """Rasterizes keypoint locations onto images (ref :118-171).
+
+  Args:
+    image: [N, H, W, 3] float images in [0, 1].
+    locations: [N, C, 2] (x, y) locations in [-1, 1].
+  Returns:
+    [num_images, H, W, 3] annotated copies.
+  """
+  image = np.asarray(image, np.float32)
+  locations = np.asarray(locations)
+  num_images = min(num_images, image.shape[0])
+  out = image[:num_images].copy()
+  height, width = image.shape[1:3]
+  for n in range(num_images):
+    for c in range(locations.shape[1]):
+      x, y = locations[n, c]
+      col = int((x + 1.0) / 2.0 * (width - 1))
+      row = int((y + 1.0) / 2.0 * (height - 1))
+      r0, r1 = max(0, row - dot_radius), min(height, row + dot_radius + 1)
+      c0, c1 = max(0, col - dot_radius), min(width, col + dot_radius + 1)
+      out[n, r0:r1, c0:c1] = np.asarray([1.0, 0.0, 0.0])
+  return out
+
+
+def distance_histograms(pregrasp, goal, postgrasp) -> Dict[str, np.ndarray]:
+  """The evaluation histograms of ref plot_distances (:63-79), as arrays."""
+  pregrasp = np.asarray(pregrasp, np.float32)
+  goal = np.asarray(goal, np.float32)
+  postgrasp = np.asarray(postgrasp, np.float32)
+  goal_normalized = goal / (1e-7 + np.linalg.norm(goal, axis=1,
+                                                  keepdims=True))
+  return {
+      'correct_distances': np.linalg.norm(pregrasp - (goal + postgrasp),
+                                          axis=1),
+      'incorrect_distances': np.linalg.norm(pregrasp - pregrasp[::-1],
+                                            axis=1),
+      'goal_distances': np.linalg.norm(goal - goal[::-1], axis=1),
+      'pregrasp_sizes': np.linalg.norm(pregrasp, axis=1),
+      'postgrasp_sizes': np.linalg.norm(postgrasp, axis=1),
+      'goal_sizes': np.linalg.norm(goal, axis=1),
+      'goal_cosine_similarity': np.sum(
+          goal_normalized[:-1] * goal_normalized[1:], axis=1),
+  }
+
+
+def grasp2vec_summaries(features, inference_outputs
+                        ) -> Dict[str, np.ndarray]:
+  """All add_summaries artifacts as a {name: array} dict (ref :224-246).
+
+  Images come back as [N, H, W, C] float arrays; 1-D entries are histogram
+  samples. Feed to MetricsWriter.write_images/write_histograms.
+  """
+  out: Dict[str, np.ndarray] = {}
+  for key in ('pregrasp', 'postgrasp', 'goal'):
+    name = key + '_image'
+    if name in features:
+      out['image/' + key] = np.asarray(features[name])[:3]
+  heatmaps = compute_heatmaps(inference_outputs['goal_vector'],
+                              inference_outputs['pre_spatial'])
+  out['goal_pregrasp_map'] = np.asarray(heatmaps)[:3]
+  out['goal_pregrasp_map_softmax'] = np.asarray(
+      softmax_heatmaps(heatmaps))[:3]
+  locations = heatmap_spatial_soft_argmax(heatmaps)
+  if 'pregrasp_image' in features:
+    out['keypoints'] = np_render_keypoints(
+        np.asarray(features['pregrasp_image']), np.asarray(locations))
+  for name, values in distance_histograms(
+      inference_outputs['pre_vector'], inference_outputs['goal_vector'],
+      inference_outputs['post_vector']).items():
+    out['hist/' + name] = values
+  return out

@@ -1,0 +1,163 @@
+"""Action decoders for VRGripper BC models: MSE, MDN, MAF, discrete.
+
+Parity targets:
+  * MSEDecoder       /root/reference/research/vrgripper/mse_decoder.py:31
+  * MAFDecoder       /root/reference/research/vrgripper/maf.py:72
+  * DiscreteDecoder + bin helpers
+                     /root/reference/research/vrgripper/discrete.py:37-143
+  * (MDN decoding lives in layers/mdn.py, ref layers/mdn.py:129)
+
+The reference decoders are stateful objects (``__call__`` builds the head,
+``loss(labels)`` reads cached tensors). Functionally they become Flax
+modules with one entry point::
+
+    decoder(params_input, labels_action=None, rng=None)
+      -> SpecStruct(action=..., [nll/logits/...], [loss=...])
+
+``loss`` is returned alongside the action when labels are provided, so the
+whole decode+loss runs inside the one jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import maf as maf_lib
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+
+class MSEDecoder(nn.Module):
+  """Plain linear head + mean squared error (ref mse_decoder.py:31)."""
+
+  output_size: int
+
+  @nn.compact
+  def __call__(self, params_input, labels_action=None, rng=None) -> SpecStruct:
+    predictions = nn.Dense(self.output_size, name='pose')(params_input)
+    out = SpecStruct(action=predictions)
+    if labels_action is not None:
+      labels_action = jnp.asarray(labels_action, jnp.float32)
+      out['loss'] = jnp.mean(
+          (predictions.astype(jnp.float32) - labels_action) ** 2)
+    return out
+
+
+class MDNActionDecoder(nn.Module):
+  """Gaussian-mixture head (ref layers/mdn.py:129 MDNDecoder).
+
+  Action = approximate mixture mode (or a sample when ``rng`` is given);
+  loss = mean NLL of the labels under the mixture.
+  """
+
+  output_size: int
+  num_mixture_components: int = 1
+  condition_sigmas: bool = False
+
+  @nn.compact
+  def __call__(self, params_input, labels_action=None, rng=None) -> SpecStruct:
+    dist_params = mdn.MDNParamsLayer(
+        num_alphas=self.num_mixture_components,
+        sample_size=self.output_size,
+        condition_sigmas=self.condition_sigmas,
+        name='mdn_params')(params_input)
+    gm = mdn.get_mixture_distribution(
+        dist_params.astype(jnp.float32), self.num_mixture_components,
+        self.output_size)
+    if rng is not None:
+      action = mdn.mixture_sample(gm, rng)
+    else:
+      action = mdn.gaussian_mixture_approximate_mode(gm)
+    out = SpecStruct(action=action, dist_params=dist_params)
+    if labels_action is not None:
+      out['loss'] = mdn.mdn_loss(gm, jnp.asarray(labels_action, jnp.float32))
+    return out
+
+
+class MAFDecoder(nn.Module):
+  """Masked-autoregressive-flow head (ref maf.py:72)."""
+
+  output_size: int
+  num_flows: int = 1
+  hidden_layers: Tuple[int, ...] = (512, 512)
+
+  @nn.compact
+  def __call__(self, params_input, labels_action=None, rng=None) -> SpecStruct:
+    dist = maf_lib.MAFDistribution(
+        output_size=self.output_size, num_flows=self.num_flows,
+        hidden_layers=self.hidden_layers, name='maf')
+    value = (jnp.asarray(labels_action, jnp.float32)
+             if labels_action is not None else None)
+    sample, log_prob = dist(params_input, value=value, rng=rng)
+    out = SpecStruct(action=sample)
+    if log_prob is not None:
+      # Average across batch and sequence (ref maf.py:100-103).
+      out['loss'] = -jnp.mean(log_prob)
+    return out
+
+
+# -- discrete actions ---------------------------------------------------------
+
+
+def get_discrete_bins(num_bins: int, output_min, output_max) -> np.ndarray:
+  """[num_bins, action_dim] bin centers (ref discrete.py:37)."""
+  output_min = np.asarray(output_min, np.float32)
+  output_max = np.asarray(output_max, np.float32)
+  bin_sizes = (output_max - output_min) / float(num_bins)
+  return np.array([output_min + bin_sizes * (bin_i + 0.5)
+                   for bin_i in range(num_bins)], dtype=np.float32)
+
+
+def get_discrete_actions(logits: jnp.ndarray, action_size: int,
+                         num_bins: int, bin_centers) -> jnp.ndarray:
+  """Mode action per dimension from bin logits (ref discrete.py:56)."""
+  leading = logits.shape[:-1]
+  probabilities = jax.nn.softmax(
+      logits.reshape((-1, action_size, num_bins)).astype(jnp.float32))
+  one_hot = jax.nn.one_hot(jnp.argmax(probabilities, -1), num_bins)
+  centers = jnp.asarray(np.transpose(bin_centers))  # [action_dim, num_bins]
+  actions = jnp.sum(one_hot * centers, -1)
+  return actions.reshape(leading + (action_size,))
+
+
+def get_discrete_action_loss(logits: jnp.ndarray, action_labels: jnp.ndarray,
+                             bin_centers, num_bins: int) -> jnp.ndarray:
+  """Cross entropy against the nearest-bin one-hot (ref discrete.py:87)."""
+  action_labels = jnp.asarray(action_labels, jnp.float32)[..., None, :]
+  centers = jnp.asarray(bin_centers)  # [num_bins, action_dim]
+  while centers.ndim < action_labels.ndim:
+    centers = centers[None, ...]
+  discrete_labels = jnp.argmin((action_labels - centers) ** 2, -2)
+  one_hot = jax.nn.one_hot(discrete_labels, num_bins).reshape((-1, num_bins))
+  logits = logits.reshape((-1, num_bins)).astype(jnp.float32)
+  log_probs = jax.nn.log_softmax(logits)
+  return -jnp.mean(jnp.sum(one_hot * log_probs, axis=-1))
+
+
+class DiscreteDecoder(nn.Module):
+  """Per-dimension discretized action head (ref discrete.py:112)."""
+
+  output_size: int
+  num_bins: int = 1
+  output_min: Sequence[float] = ()
+  output_max: Sequence[float] = ()
+
+  @nn.compact
+  def __call__(self, params_input, labels_action=None, rng=None) -> SpecStruct:
+    bin_centers = get_discrete_bins(self.num_bins,
+                                    np.asarray(self.output_min),
+                                    np.asarray(self.output_max))
+    logits = nn.Dense(self.output_size * self.num_bins,
+                      name='action_logits')(params_input)
+    action = get_discrete_actions(logits, self.output_size, self.num_bins,
+                                  bin_centers)
+    out = SpecStruct(action=action, action_logits=logits)
+    if labels_action is not None:
+      out['loss'] = get_discrete_action_loss(logits, labels_action,
+                                             bin_centers, self.num_bins)
+    return out

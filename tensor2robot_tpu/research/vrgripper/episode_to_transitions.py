@@ -1,0 +1,78 @@
+"""Episode -> serialized-example converters for VRGripper replay data.
+
+Parity target: /root/reference/research/vrgripper/episode_to_transitions.py
+(make_fixed_length :45, episode_to_transitions_reacher :88,
+episode_to_transitions_metareacher :108). tf.train.Example construction is
+replaced by the dependency-free wire codec (data/wire.py), producing
+byte-identical record framing.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.data import wire
+
+
+def make_fixed_length(input_list,
+                      fixed_length: int,
+                      always_include_endpoints: bool = True,
+                      randomized: bool = True) -> Optional[list]:
+  """Samples ``input_list`` down/up to ``fixed_length`` entries (ref :45).
+
+  Returns None for episodes of length <= 2 (too short to subsample).
+  """
+  original_length = len(input_list)
+  if original_length <= 2:
+    return None
+  if not randomized:
+    indices = np.sort(np.mod(np.arange(fixed_length), original_length))
+    return [input_list[i] for i in indices]
+  if always_include_endpoints:
+    endpoint_indices = np.array([0, original_length - 1])
+    other_indices = 1 + np.random.choice(
+        original_length - 2, fixed_length - 2, replace=True)
+    indices = np.concatenate((endpoint_indices, other_indices), axis=0)
+  else:
+    indices = np.random.choice(original_length, fixed_length, replace=True)
+  indices = np.sort(indices)
+  return [input_list[i] for i in indices]
+
+
+def episode_to_transitions_reacher(episode_data, is_demo: bool = False
+                                   ) -> List[bytes]:
+  """Reacher env transitions -> one serialized Example each (ref :88)."""
+  transitions = []
+  for transition in episode_data:
+    obs_t, action, reward, obs_tp1, done, debug = transition
+    del debug
+    transitions.append(wire.build_example({
+        'pose_t': np.asarray(obs_t, np.float32),
+        'pose_tp1': np.asarray(obs_tp1, np.float32),
+        'action': np.asarray(action, np.float32),
+        'reward': np.asarray([reward], np.float32),
+        'done': np.asarray([int(done)], np.int64),
+        'is_demo': np.asarray([int(is_demo)], np.int64),
+    }))
+  return transitions
+
+
+def episode_to_transitions_metareacher(episode_data) -> List[bytes]:
+  """Meta-reacher episode -> ONE serialized SequenceExample (ref :108)."""
+  context = {
+      'is_demo': np.asarray([int(episode_data[0][-1]['is_demo'])], np.int64),
+      'target_idx': np.asarray([episode_data[0][-1]['target_idx']], np.int64),
+  }
+  feature_lists = collections.defaultdict(list)
+  for transition in episode_data:
+    obs_t, action, reward, obs_tp1, done, debug = transition
+    del debug
+    feature_lists['pose_t'].append(np.asarray(obs_t, np.float32))
+    feature_lists['pose_tp1'].append(np.asarray(obs_tp1, np.float32))
+    feature_lists['action'].append(np.asarray(action, np.float32))
+    feature_lists['reward'].append(np.asarray([reward], np.float32))
+    feature_lists['done'].append(np.asarray([int(done)], np.int64))
+  return [wire.build_sequence_example(context, dict(feature_lists))]

@@ -1,0 +1,511 @@
+"""VRGripper meta models: MAML wrapper, TEC, and SNAIL/RL^2 sequential BC.
+
+Parity target: /root/reference/research/vrgripper/vrgripper_env_meta_models.py
+(pack_vrgripper_meta_features :46, VRGripperEnvRegressionModelMAML :123,
+VRGripperEnvTecModel :143, VRGripperEnvSequentialModel :421). TF1
+responsibilities map as:
+
+  * tf.map_fn / multi_batch_apply scope reuse -> shared Flax submodules
+    applied over merged [task, episode] batch dims.
+  * mdn/MAF/MSE decoder objects caching tensors for .loss() -> the decoder
+    modules of ``research.vrgripper.decoders`` computing action and loss in
+    one call inside the jitted step.
+  * the internal metatidy SNAIL (ref :435, not in OSS) -> an explicit
+    per-frame vision tower + TCBlock/AttentionBlock stack from
+    ``layers.snail`` over the concatenated condition+inference sequence.
+
+Meta feature layout (flat keys, fixed sample counts):
+  condition/features/image        [B, n_cond, T, 100, 100, 3]
+  condition/features/gripper_pose [B, n_cond, T, 14]
+  condition/labels/action         [B, n_cond, T, A]
+  inference/features/*            [B, n_inf, T, ...]
+  labels: action                  [B, n_inf, T, A]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import snail
+from tensor2robot_tpu.layers import tec
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.meta_learning import preprocessors as meta_preprocessors
+from tensor2robot_tpu.meta_learning.maml_model import MAMLRegressionModel
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.vrgripper import decoders
+from tensor2robot_tpu.research.vrgripper import vrgripper_env_models
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+def pack_vrgripper_meta_features(state, prev_episode_data, timestep,
+                                 fixed_length: int,
+                                 num_condition_samples_per_task: int
+                                 ) -> Dict[str, np.ndarray]:
+  """Current state + conditioning episodes -> meta feed dict (ref :46-119).
+
+  ``state``: dict/namedtuple with 'image' (uint8 [H, W, 3]) and 'pose'.
+  ``prev_episode_data``: list of episodes; each a list of
+  (obs, action, rew, new_obs, done, debug) tuples whose obs carry
+  image/pose.
+  """
+  del timestep
+  if len(prev_episode_data) < 1:
+    raise ValueError(
+        'prev_episode_data should at least contain one (demo) episode.')
+
+  def _get(obj, key):
+    return obj[key] if isinstance(obj, dict) else getattr(obj, key)
+
+  features = {}
+  image = np.asarray(_get(state, 'image'))
+  pose = np.asarray(_get(state, 'pose'), np.float32)
+  features['inference/features/image'] = np.tile(
+      image[None], (fixed_length,) + (1,) * image.ndim).astype(np.uint8)
+  features['inference/features/gripper_pose'] = np.tile(
+      pose[None], (fixed_length,) + (1,) * pose.ndim)
+
+  cond_images, cond_poses, cond_actions = [], [], []
+  from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
+      make_fixed_length,
+  )
+  for i in range(num_condition_samples_per_task):
+    episode = make_fixed_length(
+        prev_episode_data[i % len(prev_episode_data)], fixed_length)
+    cond_images.append(np.stack(
+        [np.asarray(_get(t[0], 'image')) for t in episode]))
+    cond_poses.append(np.stack(
+        [np.asarray(_get(t[0], 'pose'), np.float32) for t in episode]))
+    cond_actions.append(np.stack(
+        [np.asarray(t[1], np.float32) for t in episode]))
+  features['condition/features/image'] = np.stack(cond_images).astype(
+      np.uint8)
+  features['condition/features/gripper_pose'] = np.stack(cond_poses)
+  features['condition/labels/action'] = np.stack(cond_actions)
+  # Meta (task) batch dim; inference features also gain the episodes dim.
+  for key in list(features):
+    if key.startswith('inference/'):
+      features[key] = features[key][None]
+    features[key] = features[key][None]
+  return features
+
+
+class VRGripperEnvRegressionModelMAML(MAMLRegressionModel):
+  """MAML over the VRGripper regression model (ref :123-139)."""
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    return pack_vrgripper_meta_features(
+        state, prev_episode_data, timestep,
+        self._base_model.episode_length, 1)
+
+
+class _FixedCountMetaModel(AbstractT2RModel):
+  """Shared plumbing for standalone meta models (TEC / SNAIL / WTL).
+
+  Declares the fixed-count meta specs from per-episode specs and routes
+  labels into the network so decoder losses are computed in-graph.
+  """
+
+  def __init__(self,
+               episode_length: int = 40,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1,
+               **kwargs):
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(**kwargs)
+    self._episode_length = episode_length
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    raise NotImplementedError
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    raise NotImplementedError
+
+  def _base_preprocessor_cls(self):
+    return vrgripper_env_models.DefaultVRGripperPreprocessor
+
+  @property
+  def preprocessor(self):
+    if self._preprocessor is None:
+      base = self._base_preprocessor_cls()(
+          model_feature_specification_fn=self._episode_feature_specification,
+          model_label_specification_fn=self._episode_label_specification)
+      self._preprocessor = meta_preprocessors.FixedLenMetaExamplePreprocessor(
+          base_preprocessor=base,
+          num_condition_samples_per_task=self._num_condition,
+          num_inference_samples_per_task=self._num_inference)
+    return self._preprocessor
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    return meta_preprocessors.create_maml_feature_spec(
+        self._episode_feature_specification(mode),
+        self._episode_label_specification(mode),
+        self._num_condition, self._num_inference)
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    return meta_preprocessors.create_maml_label_spec(
+        self._episode_label_specification(mode), self._num_inference)
+
+  def inference_network_fn(self, variables, features, labels=None,
+                           mode: str = ModeKeys.TRAIN, rng=None):
+    """Like the base default, but labels reach the network (decoder loss)."""
+    import flax
+
+    network = self.create_network()
+    train = mode == ModeKeys.TRAIN
+    rngs = {'dropout': rng} if rng is not None else None
+    labels_dict = dict(labels) if labels is not None and len(labels) else None
+    mutable = [k for k in variables if k != 'params'] if train else False
+    if mutable:
+      outputs, new_state = network.apply(
+          variables, features, labels_dict, mode=mode, train=train,
+          rngs=rngs, mutable=mutable)
+      return outputs, flax.core.unfreeze(new_state)
+    outputs = network.apply(variables, features, labels_dict, mode=mode,
+                            train=train, rngs=rngs)
+    return outputs, None
+
+
+class _TecNet(nn.Module):
+  """TEC network (ref :239-317): condition embedding -> policy."""
+
+  action_size: int
+  num_waypoints: int
+  episode_length: int
+  fc_embed_size: int
+  ignore_embedding: bool
+  use_film: bool
+  predict_end_weight: float
+  decoder_cls: Type[nn.Module]
+  decoder_kwargs: Optional[Dict[str, Any]] = None
+
+  def _embed_episode(self, embedder, reducer, images, train: bool):
+    """[B, n, T, H, W, C] -> l2-normalized [B, n, embed] (ref :239-249).
+
+    ``embedder``/``reducer`` are shared module INSTANCES (the reference's
+    AUTO_REUSE variable scopes): condition and inference episodes embed
+    through the same weights.
+    """
+    image_embedding = meta_data.multi_batch_apply(
+        lambda im: embedder(im, train=train), 3, images)
+    embedding = meta_data.multi_batch_apply(reducer, 2, image_embedding)
+    return embedding / jnp.maximum(
+        jnp.linalg.norm(embedding, axis=-1, keepdims=True), 1e-12)
+
+  @nn.compact
+  def __call__(self, features, labels=None, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    condition_images = jnp.asarray(
+        features['condition/features/image'], jnp.float32)
+    inference_images = jnp.asarray(
+        features['inference/features/image'], jnp.float32)
+    gripper_pose = jnp.asarray(
+        features['inference/features/gripper_pose'], jnp.float32)
+
+    embedder = tec.EmbedConditionImages(name='image_embedding')
+    reducer = tec.ReduceTemporalEmbeddings(self.fc_embed_size,
+                                           name='fc_reduce')
+    condition_embedding = self._embed_episode(embedder, reducer,
+                                              condition_images, train)
+
+    film_output_params = None
+    if self.use_film:
+      film_output_params = meta_data.multi_batch_apply(
+          vision_layers.FilmParams(name='film_params'), 2,
+          condition_embedding)
+      film_output_params = jnp.broadcast_to(
+          film_output_params[:, :, None, :],
+          film_output_params.shape[:2] + (self.episode_length,) +
+          film_output_params.shape[-1:])
+
+    def _tower(image, film):
+      return vision_layers.ImagesToFeaturesNet(name='state_features')(
+          image, film_output_params=film, train=train)
+
+    if film_output_params is None:
+      state_features, _ = meta_data.multi_batch_apply(
+          lambda im: _tower(im, None), 3, inference_images)
+    else:
+      state_features, _ = meta_data.multi_batch_apply(
+          _tower, 3, inference_images, film_output_params)
+
+    fc_embedding = jnp.broadcast_to(
+        condition_embedding[..., :self.fc_embed_size][:, :, None, :],
+        state_features.shape[:3] + (self.fc_embed_size,))
+    if self.ignore_embedding:
+      fc_inputs = jnp.concatenate([state_features, gripper_pose], -1)
+    else:
+      fc_inputs = jnp.concatenate(
+          [state_features, gripper_pose, fc_embedding], -1)
+
+    aux_output_dim = 1 if self.predict_end_weight > 0 else 0
+    pose_net = vision_layers.ImageFeaturesToPoseNet(
+        num_outputs=None, aux_output_dim=aux_output_dim, name='a_func')
+    if aux_output_dim:
+      action_params, end_token = meta_data.multi_batch_apply(
+          pose_net, 3, fc_inputs)
+    else:
+      action_params = meta_data.multi_batch_apply(pose_net, 3, fc_inputs)
+      end_token = None
+
+    decoder = self.decoder_cls(
+        output_size=self.num_waypoints * self.action_size,
+        name='action_decoder', **(self.decoder_kwargs or {}))
+    decoded = decoder(
+        action_params,
+        labels_action=None if labels is None else labels['action'])
+
+    outputs = SpecStruct(
+        inference_output=decoded['action'],
+        condition_embedding=condition_embedding)
+    if 'loss' in decoded:
+      outputs['bc_loss'] = decoded['loss']
+    if end_token is not None:
+      outputs['end_token_logits'] = end_token
+      outputs['end_token'] = jax.nn.sigmoid(end_token)
+      outputs['inference_output'] = jnp.concatenate(
+          [outputs['inference_output'], outputs['end_token']], -1)
+    if mode != ModeKeys.PREDICT:
+      outputs['inference_embedding'] = self._embed_episode(
+          embedder, reducer, inference_images, train)
+    return outputs
+
+
+class VRGripperEnvTecModel(_FixedCountMetaModel):
+  """Task-Embedded Control network (ref :143-417, arXiv:1810.03237)."""
+
+  def __init__(self,
+               action_size: int = 7,
+               gripper_pose_size: int = 14,
+               num_waypoints: int = 1,
+               embed_loss_weight: float = 0.0,
+               fc_embed_size: int = 32,
+               ignore_embedding: bool = False,
+               action_decoder_cls: Type[nn.Module] = decoders.MDNActionDecoder,
+               action_decoder_kwargs: Optional[dict] = None,
+               predict_end_weight: float = 0.0,
+               use_film: bool = False,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._gripper_pose_size = gripper_pose_size
+    self._num_waypoints = num_waypoints
+    self._embed_loss_weight = embed_loss_weight
+    self._fc_embed_size = fc_embed_size
+    self._ignore_embedding = ignore_embedding
+    self._action_decoder_cls = action_decoder_cls
+    self._action_decoder_kwargs = dict(action_decoder_kwargs or {})
+    self._predict_end_weight = predict_end_weight
+    self._use_film = use_film
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    """ref :190-203."""
+    del mode
+    return SpecStruct(
+        image=TensorSpec((self._episode_length, 100, 100, 3), np.float32,
+                         name='image0', data_format='jpeg'),
+        gripper_pose=TensorSpec(
+            (self._episode_length, self._gripper_pose_size), np.float32,
+            name='world_pose_gripper'))
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    """ref :205-214."""
+    del mode
+    return SpecStruct(action=TensorSpec(
+        (self._episode_length, self._num_waypoints * self._action_size),
+        np.float32, name='action_world'))
+
+  def create_network(self) -> nn.Module:
+    return _TecNet(
+        action_size=self._action_size,
+        num_waypoints=self._num_waypoints,
+        episode_length=self._episode_length,
+        fc_embed_size=self._fc_embed_size,
+        ignore_embedding=self._ignore_embedding,
+        use_film=self._use_film,
+        predict_end_weight=self._predict_end_weight,
+        decoder_cls=self._action_decoder_cls,
+        decoder_kwargs=self._action_decoder_kwargs or None)
+
+  def _end_loss(self, inference_outputs) -> jnp.ndarray:
+    """Last two steps labeled as end states (ref :319-333)."""
+    if self._predict_end_weight <= 0:
+      return jnp.zeros((), jnp.float32)
+    logits = inference_outputs['end_token_logits'].astype(jnp.float32)
+    end_labels = jnp.concatenate(
+        [jnp.zeros_like(logits[:, :, :-2, :]),
+         jnp.ones_like(logits[:, :, -2:, :])], 2)
+    import optax
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, end_labels))
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """bc + weighted contrastive embedding + end losses (ref :335-354)."""
+    bc_loss = inference_outputs['bc_loss']
+    embed_loss = tec.compute_embedding_contrastive_loss(
+        inference_outputs['inference_embedding'],
+        inference_outputs['condition_embedding'])
+    end_loss = self._end_loss(inference_outputs)
+    train_outputs = SpecStruct(bc_loss=bc_loss, embed_loss=embed_loss,
+                               end_loss=end_loss)
+    return (bc_loss + self._embed_loss_weight * embed_loss +
+            self._predict_end_weight * end_loss), train_outputs
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    """Streaming means of the train losses (ref :356-371)."""
+    loss, train_outputs = self.model_train_fn(
+        variables, features, labels, inference_outputs, mode)
+    metrics = SpecStruct(loss=loss)
+    for key in train_outputs:
+      metrics[key] = train_outputs[key]
+    return metrics
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    """ref :397-417."""
+    return pack_vrgripper_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition)
+
+
+class _SnailSequenceNet(nn.Module):
+  """Per-frame vision tower + SNAIL temporal stack (ref metatidy SNAIL).
+
+  Consumes the full condition+inference frame sequence causally and emits
+  one action parameterization per time step.
+  """
+
+  output_size: int
+  sequence_length: int
+  filters: int = 32
+  key_size: int = 16
+  value_size: int = 16
+
+  @nn.compact
+  def __call__(self, images, aux_input, train: bool = False):
+    state_features, _ = meta_data.multi_batch_apply(
+        lambda im: vision_layers.ImagesToFeaturesNet(
+            name='state_features')(im, train=train), 2, images)
+    net = jnp.concatenate([state_features, aux_input], -1)
+    net = snail.TCBlock(self.sequence_length, self.filters, name='tc1')(net)
+    net, _ = snail.AttentionBlock(self.key_size, self.value_size,
+                                  name='attn1')(net)
+    net = snail.TCBlock(self.sequence_length, self.filters, name='tc2')(net)
+    net, end_points = snail.AttentionBlock(self.key_size, self.value_size,
+                                           name='attn2')(net)
+    poses = nn.Dense(self.output_size, name='poses')(net)
+    return poses, {'attn_probs/0': end_points['attn_prob']}
+
+
+class VRGripperEnvSequentialModel(VRGripperEnvTecModel):
+  """RL^2 / SNAIL sequential meta-learner (ref :421-533).
+
+  Conditions causally on the (optionally action-blind) demo sequence
+  followed by the inference sequence; only the inference tail is decoded.
+  """
+
+  def __init__(self,
+               condition_gripper_pose: bool = False,
+               num_mixture_components: int = 1,
+               greedy_action: bool = False,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._condition_gripper_pose = condition_gripper_pose
+    self._num_mixture_components = num_mixture_components
+    self._greedy_action = greedy_action
+
+  def create_network(self) -> nn.Module:
+    return _SequentialNet(
+        action_size=self._action_size,
+        episode_length=self._episode_length,
+        num_mixture_components=self._num_mixture_components,
+        condition_gripper_pose=self._condition_gripper_pose)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """NLL or MSE over the inference tail (ref :514-533)."""
+    bc_loss = inference_outputs['bc_loss']
+    return bc_loss, SpecStruct(bc_loss=bc_loss)
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, train_outputs = self.model_train_fn(
+        variables, features, labels, inference_outputs, mode)
+    metrics = SpecStruct(loss=loss)
+    for key in train_outputs:
+      metrics[key] = train_outputs[key]
+    return metrics
+
+
+class _SequentialNet(nn.Module):
+  """Wires _SnailSequenceNet into the meta feature layout (ref :458-512)."""
+
+  action_size: int
+  episode_length: int
+  num_mixture_components: int = 1
+  condition_gripper_pose: bool = False
+
+  @nn.compact
+  def __call__(self, features, labels=None, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    condition_images = jnp.asarray(
+        features['condition/features/image'], jnp.float32)
+    inference_images = jnp.asarray(
+        features['inference/features/image'], jnp.float32)
+    cond_pose = jnp.asarray(
+        features['condition/features/gripper_pose'], jnp.float32)
+    inf_pose = jnp.asarray(
+        features['inference/features/gripper_pose'], jnp.float32)
+    if not self.condition_gripper_pose:
+      # Imitation-from-video: no demo actions/poses (ref :471-473).
+      cond_pose = jnp.zeros_like(cond_pose)
+    condition_sequence_length = condition_images.shape[2]
+
+    # Episode 0 of condition + episode 0 of inference, across time (ref
+    # :475-481: "assuming only 1 condition, 1 inference batch for now").
+    images = jnp.concatenate(
+        [condition_images[:, 0], inference_images[:, 0]], axis=1)
+    aux_input = jnp.concatenate([cond_pose[:, 0], inf_pose[:, 0]], axis=1)
+
+    if self.num_mixture_components > 1:
+      num_mus = self.action_size * self.num_mixture_components
+      num_outputs = self.num_mixture_components + 2 * num_mus
+    else:
+      num_outputs = self.action_size
+    poses, end_points = _SnailSequenceNet(
+        output_size=num_outputs,
+        sequence_length=images.shape[1],
+        name='snail')(images, aux_input, train=train)
+
+    outputs = SpecStruct()
+    tail = poses[:, condition_sequence_length:]
+    if self.num_mixture_components > 1:
+      from tensor2robot_tpu.layers import mdn
+      gm = mdn.get_mixture_distribution(
+          tail.astype(jnp.float32), self.num_mixture_components,
+          self.action_size)
+      inference_poses = mdn.gaussian_mixture_approximate_mode(gm)
+      if labels is not None:
+        action_labels = jnp.asarray(labels['action'],
+                                    jnp.float32)[:, 0]  # episode 0
+        outputs['bc_loss'] = -jnp.mean(mdn.mixture_log_prob(
+            gm, action_labels))
+    else:
+      inference_poses = tail
+      if labels is not None:
+        action_labels = jnp.asarray(labels['action'], jnp.float32)[:, 0]
+        outputs['bc_loss'] = jnp.mean(
+            (tail.astype(jnp.float32) - action_labels) ** 2)
+    outputs['inference_output'] = inference_poses[:, None]
+    for key, value in end_points.items():
+      outputs[key] = value
+    return outputs

@@ -1,0 +1,450 @@
+"""VRGripper env models: BC regression + domain-adaptive (DAML) variants.
+
+Parity target: /root/reference/research/vrgripper/vrgripper_env_models.py
+(DefaultVRGripperPreprocessor :46, VRGripperRegressionModel :145,
+VRGripperDomainAdaptiveModel :332). The TF1 responsibilities map as:
+
+  * distortion.preprocess_image + tf.image resize (ref :108-141) -> pure
+    JAX crop (per-episode offsets shared across time) + bilinear
+    ``jax.image.resize`` + mixup, all inside the jitted step.
+  * slim towers under variable scopes -> Flax modules over the shared
+    ``layers.vision_layers`` towers.
+  * the DAML is_inner_loop/is_outer_loss params plumbing (ref :382-448) ->
+    the network emits BOTH the standard and the video-only (inner) heads
+    from one shared vision tower, and the model exposes
+    ``inner_loop_loss_fn`` which the MAML wrapper uses for adaptation.
+
+Episode data layout: every feature/label carries a leading fixed
+``episode_length`` time dim per example — batches are [B, T, ...].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.models.regression_model import RegressionModel
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import algebra
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+class DefaultVRGripperPreprocessor(AbstractPreprocessor):
+  """uint8 src-res episode frames -> cropped/resized float32 (ref :46-141).
+
+  Train mode random-crops (one offset per episode, shared across its time
+  steps — a fixed camera doesn't jitter within an episode) and applies
+  mixup when ``mixup_alpha > 0``; eval/predict center-crops.
+  """
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None,
+               src_img_res: Tuple[int, int] = (220, 300),
+               crop_size: Tuple[int, int] = (200, 280),
+               mixup_alpha: float = 0.0):
+    super().__init__(model_feature_specification_fn,
+                     model_label_specification_fn)
+    self._src_img_res = tuple(src_img_res)
+    self._crop_size = tuple(crop_size)
+    self._mixup_alpha = float(mixup_alpha)
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    """Image stored at src resolution as uint8 (ref :71-88)."""
+    spec = algebra.flatten_spec_structure(
+        self._model_feature_specification(mode))
+    out = SpecStruct()
+    for key in spec:
+      if key == 'image' or key.endswith('/image'):
+        shape = list(spec[key].shape)
+        shape[-3:-1] = self._src_img_res
+        out[key] = TensorSpec.from_spec(spec[key], shape=tuple(shape),
+                                        dtype=np.uint8)
+      else:
+        out[key] = spec[key]
+    return out
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_label_specification(mode))
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_feature_specification(mode))
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_label_specification(mode))
+
+  def _crop_episode(self, images, offsets):
+    """[B, T, H, W, C] cropped at per-episode (y, x) offsets."""
+    ch, cw = self._crop_size
+
+    def _one(episode, offset):
+      return jax.lax.dynamic_slice(
+          episode, (0, offset[0], offset[1], 0),
+          (episode.shape[0], ch, cw, episode.shape[3]))
+
+    return jax.vmap(_one)(images, offsets)
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None):
+    out_spec = self.get_out_feature_specification(mode)
+    for key in features:
+      if not (key == 'image' or key.endswith('/image')):
+        continue
+      images = jnp.asarray(features[key])
+      squeeze = images.ndim == 4  # unbatched single episode
+      if squeeze:
+        images = images[None]
+      batch = images.shape[0]
+      src_h, src_w = self._src_img_res
+      ch, cw = self._crop_size
+      if mode == ModeKeys.TRAIN and (ch, cw) != (src_h, src_w):
+        if rng is None:
+          raise ValueError('TRAIN-mode preprocessing requires an rng key.')
+        rng, ky, kx = jax.random.split(jnp.asarray(rng), 3)
+        offsets = jnp.stack([
+            jax.random.randint(ky, (batch,), 0, src_h - ch + 1),
+            jax.random.randint(kx, (batch,), 0, src_w - cw + 1)], axis=-1)
+        images = self._crop_episode(images, offsets)
+      elif (ch, cw) != (src_h, src_w):
+        y0, x0 = (src_h - ch) // 2, (src_w - cw) // 2
+        images = images[:, :, y0:y0 + ch, x0:x0 + cw, :]
+      images = jnp.asarray(images, jnp.float32) / 255.0
+      target_hw = tuple(out_spec[key].shape[-3:-1])
+      if target_hw != (ch, cw):
+        images = jax.image.resize(
+            images, images.shape[:2] + target_hw + images.shape[-1:],
+            method='bilinear')
+      features[key] = images[0] if squeeze else images
+
+    if self._mixup_alpha > 0.0 and labels is not None \
+        and mode == ModeKeys.TRAIN:
+      if rng is None:
+        raise ValueError('Mixup requires an rng key.')
+      rng, kmix = jax.random.split(jnp.asarray(rng))
+      lmbda = jax.random.beta(kmix, self._mixup_alpha, self._mixup_alpha)
+      for struct in (features, labels):
+        for key in struct:
+          value = jnp.asarray(struct[key])
+          if jnp.issubdtype(value.dtype, jnp.floating):
+            struct[key] = lmbda * value + (1 - lmbda) * jnp.flip(value, 0)
+    return features, labels
+
+
+class VRGripperRegressionNet(nn.Module):
+  """Per-frame vision tower + gripper concat + (MDN | pose) head (ref :231)."""
+
+  action_size: int
+  use_gripper_input: bool = True
+  num_mixture_components: int = 1
+  condition_mixture_stddev: bool = False
+  output_mixture_sample: bool = False
+  output_mean: Optional[Tuple[float, ...]] = None
+  output_stddev: Optional[Tuple[float, ...]] = None
+
+  @nn.compact
+  def __call__(self, features, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    def _per_frame(image):
+      return vision_layers.ImagesToFeaturesNet(name='state_features')(
+          image, train=train)
+
+    feature_points, end_points = meta_data.multi_batch_apply(
+        _per_frame, 2, jnp.asarray(features['image'], jnp.float32))
+    fc_input = feature_points
+    if self.use_gripper_input:
+      fc_input = jnp.concatenate(
+          [feature_points,
+           jnp.asarray(features['gripper_pose'], jnp.float32)], -1)
+    outputs = SpecStruct()
+    if self.num_mixture_components > 1:
+      dist_params = mdn.MDNParamsLayer(
+          num_alphas=self.num_mixture_components,
+          sample_size=self.action_size,
+          condition_sigmas=self.condition_mixture_stddev,
+          name='mdn_head')(fc_input)
+      gm = mdn.get_mixture_distribution(
+          dist_params.astype(jnp.float32), self.num_mixture_components,
+          self.action_size,
+          np.asarray(self.output_mean, np.float32)
+          if self.output_mean is not None else None)
+      action = mdn.gaussian_mixture_approximate_mode(gm)
+      outputs['dist_params'] = dist_params
+    else:
+      action = meta_data.multi_batch_apply(
+          vision_layers.ImageFeaturesToPoseNet(
+              num_outputs=self.action_size, name='pose_net'), 2, fc_input)
+      if self.output_mean is not None and self.output_stddev is not None:
+        action = (np.asarray(self.output_mean, np.float32) +
+                  np.asarray(self.output_stddev, np.float32) * action)
+    outputs['inference_output'] = action
+    outputs['feature_points'] = feature_points
+    outputs['softmax'] = end_points['softmax']
+    return outputs
+
+
+class VRGripperRegressionModel(RegressionModel):
+  """Continuous BC regression for the VRGripper env (ref :145-328)."""
+
+  label_key = 'action'
+
+  def __init__(self,
+               use_gripper_input: bool = True,
+               normalize_outputs: bool = False,
+               output_mean: Optional[Sequence[float]] = None,
+               output_stddev: Optional[Sequence[float]] = None,
+               outer_loss_multiplier: float = 1.0,
+               num_mixture_components: int = 1,
+               output_mixture_sample: bool = False,
+               condition_mixture_stddev: bool = False,
+               episode_length: int = 40,
+               action_size: int = 7,
+               preprocessor_cls=DefaultVRGripperPreprocessor,
+               **kwargs):
+    """Args mirror ref :148-199."""
+    kwargs.setdefault('device_type', 'cpu')
+    super().__init__(preprocessor_cls=preprocessor_cls, **kwargs)
+    self._use_gripper_input = use_gripper_input
+    self._normalize_outputs = normalize_outputs
+    self._outer_loss_multiplier = outer_loss_multiplier
+    self._num_mixture_components = num_mixture_components
+    self._output_mixture_sample = output_mixture_sample
+    self._condition_mixture_stddev = condition_mixture_stddev
+    self._episode_length = episode_length
+    self._action_size = action_size
+    self._output_mean = None
+    self._output_stddev = None
+    if output_mean is not None and output_stddev is not None:
+      if not len(output_mean) == len(output_stddev) == action_size:
+        raise ValueError(
+            'Output mean and stddev have lengths {:d} and {:d}.'.format(
+                len(output_mean), len(output_stddev)))
+      self._output_mean = tuple(float(x) for x in output_mean)
+      self._output_stddev = tuple(float(x) for x in output_stddev)
+
+  @property
+  def action_size(self) -> int:
+    return self._action_size
+
+  @property
+  def episode_length(self) -> int:
+    return self._episode_length
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    """ref :205-217 — [T, 100, 100, 3] image + [T, 14] gripper pose."""
+    del mode
+    return SpecStruct(
+        image=TensorSpec((self._episode_length, 100, 100, 3), np.float32,
+                         name='image0', data_format='jpeg'),
+        gripper_pose=TensorSpec((self._episode_length, 14), np.float32,
+                                name='world_pose_gripper'))
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    """ref :219-225."""
+    del mode
+    return SpecStruct(action=TensorSpec(
+        (self._episode_length, self._action_size), np.float32,
+        name='action_world'))
+
+  def create_network(self) -> nn.Module:
+    return VRGripperRegressionNet(
+        action_size=self._action_size,
+        use_gripper_input=self._use_gripper_input,
+        num_mixture_components=self._num_mixture_components,
+        condition_mixture_stddev=self._condition_mixture_stddev,
+        output_mixture_sample=self._output_mixture_sample,
+        output_mean=(self._output_mean if self._normalize_outputs
+                     or self._num_mixture_components == 1 else None),
+        output_stddev=(self._output_stddev if self._normalize_outputs
+                       or self._num_mixture_components == 1 else None))
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """MDN NLL or scaled MSE (ref loss_fn :315-328)."""
+    action_labels = jnp.asarray(labels[self.label_key], jnp.float32)
+    if self._num_mixture_components > 1:
+      gm = mdn.get_mixture_distribution(
+          inference_outputs['dist_params'].astype(jnp.float32),
+          self._num_mixture_components, self._action_size,
+          np.asarray(self._output_mean, np.float32)
+          if self._normalize_outputs and self._output_mean is not None
+          else None)
+      loss = -jnp.mean(mdn.mixture_log_prob(gm, action_labels))
+    else:
+      predictions = inference_outputs['inference_output']
+      loss = self._outer_loss_multiplier * jnp.mean(
+          (predictions.astype(jnp.float32) - action_labels) ** 2)
+    return loss, SpecStruct()
+
+  def pack_features(self, state, context, timestep) -> dict:
+    """One observation tiled to the episode length (serving)."""
+    del context, timestep
+    image = np.tile(np.asarray(state['image'])[None],
+                    (self._episode_length, 1, 1, 1))
+    pose = np.tile(np.asarray(state['pose'], np.float32)[None],
+                   (self._episode_length, 1))
+    return {'image': image[None], 'gripper_pose': pose[None]}
+
+
+class VRGripperDomainAdaptiveNet(nn.Module):
+  """DAML network: shared tower, standard + video-only heads, learned loss.
+
+  The policy lives under the 'policy' scope (adapted in the inner loop);
+  the learned loss under 'learned_loss' (meta-trained only) — the MAML
+  wrapper's var_scope='policy' freezes it during adaptation.
+  """
+
+  action_size: int
+  predict_con_gripper_pose: bool = False
+  learned_loss_conv1d_layers: Optional[Tuple[int, ...]] = (10, 10, 6)
+  output_mean: Optional[Tuple[float, ...]] = None
+  output_stddev: Optional[Tuple[float, ...]] = None
+
+  @nn.compact
+  def __call__(self, features, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    images = jnp.asarray(features['image'], jnp.float32)
+    gripper_pose = jnp.asarray(features['gripper_pose'], jnp.float32)
+
+    def _tower(image):
+      return vision_layers.ImagesToFeaturesNet(name='state_features')(
+          image, train=train)
+
+    class _Policy(nn.Module):
+      """Groups adapted params under one scope for var_scope filtering."""
+      action_size: int
+      predict_con_gripper_pose: bool
+
+      @nn.compact
+      def __call__(self, images, gripper_pose):
+        feature_points, end_points = meta_data.multi_batch_apply(
+            _tower, 2, images)
+        # Inner (video-only) gripper pose: predicted or zeros (ref :382-388).
+        if self.predict_con_gripper_pose:
+          con_pose = meta_data.multi_batch_apply(
+              _PredictGripperPose(name='gripper_pose_predictor'), 2,
+              feature_points)
+        else:
+          con_pose = jnp.zeros_like(gripper_pose)
+        pose_net = vision_layers.ImageFeaturesToPoseNet(
+            num_outputs=self.action_size, name='pose_net')
+
+        def _head(fp, aux):
+          return pose_net(fp, aux_input=aux)
+
+        action = meta_data.multi_batch_apply(
+            _head, 2, feature_points, gripper_pose)
+        action_inner = meta_data.multi_batch_apply(
+            _head, 2, feature_points, con_pose)
+        return action, action_inner, feature_points, end_points
+
+    action, action_inner, feature_points, end_points = _Policy(
+        self.action_size, self.predict_con_gripper_pose, name='policy')(
+            images, gripper_pose)
+    if self.output_mean is not None and self.output_stddev is not None:
+      mean = np.asarray(self.output_mean, np.float32)
+      stddev = np.asarray(self.output_stddev, np.float32)
+      action = mean + stddev * action
+      action_inner = mean + stddev * action_inner
+
+    outputs = SpecStruct(
+        inference_output=action,
+        inference_output_inner=action_inner,
+        feature_points=feature_points)
+    outputs['softmax'] = end_points['softmax']
+    outputs['learned_loss_value'] = _LearnedLoss(
+        action_size=self.action_size,
+        conv1d_layers=self.learned_loss_conv1d_layers,
+        name='learned_loss')(feature_points, action_inner)
+    return outputs
+
+
+class _PredictGripperPose(nn.Module):
+  """Condition gripper pose from feature points (ref :356-362)."""
+
+  @nn.compact
+  def __call__(self, feature_points):
+    out = nn.Dense(40, use_bias=False)(feature_points)
+    out = nn.LayerNorm()(out)
+    out = nn.relu(out)
+    return nn.Dense(14)(out)
+
+
+class _LearnedLoss(nn.Module):
+  """Temporal conv learned loss (ref model_train_fn :426-448)."""
+
+  action_size: int
+  conv1d_layers: Optional[Tuple[int, ...]] = (10, 10, 6)
+
+  @nn.compact
+  def __call__(self, feature_points, inference_output):
+    predicted_action = meta_data.multi_batch_apply(
+        vision_layers.ImageFeaturesToPoseNet(
+            num_outputs=self.action_size, name='ll_pose'), 2,
+        feature_points)
+    if self.conv1d_layers is None:
+      return jnp.mean(
+          (predicted_action - jax.lax.stop_gradient(inference_output)) ** 2)
+    net = jnp.concatenate(
+        [predicted_action, feature_points, inference_output], -1)
+    for i, num_filters in enumerate(self.conv1d_layers[:-1]):
+      net = nn.Conv(num_filters, (10,), padding='VALID', use_bias=False,
+                    name='ll_conv{}'.format(i))(net)
+      net = nn.relu(net)
+      net = nn.LayerNorm()(net)
+    net = nn.Conv(self.conv1d_layers[-1], (1,), name='ll_conv_out')(net)
+    return jnp.mean(jnp.sum(jnp.square(net), axis=(1, 2)))
+
+
+class VRGripperDomainAdaptiveModel(VRGripperRegressionModel):
+  """Learned-loss domain-adaptive imitation (ref :332-448).
+
+  Wrap with ``MAMLRegressionModel(base_model=...,
+  inner_loop=MAMLInnerLoopGradientDescent(var_scope='policy'))`` so only
+  the policy adapts and the learned loss is meta-trained by the outer loop.
+  """
+
+  def __init__(self,
+               predict_con_gripper_pose: bool = False,
+               learned_loss_conv1d_layers: Tuple[int, ...] = (10, 10, 6),
+               **kwargs):
+    super().__init__(**kwargs)
+    self._predict_con_gripper_pose = predict_con_gripper_pose
+    self._learned_loss_conv1d_layers = (
+        tuple(learned_loss_conv1d_layers)
+        if learned_loss_conv1d_layers is not None else None)
+
+  def create_network(self) -> nn.Module:
+    return VRGripperDomainAdaptiveNet(
+        action_size=self._action_size,
+        predict_con_gripper_pose=self._predict_con_gripper_pose,
+        learned_loss_conv1d_layers=self._learned_loss_conv1d_layers,
+        output_mean=self._output_mean,
+        output_stddev=self._output_stddev)
+
+  def inner_loop_loss_fn(self, variables, features, labels,
+                         inference_outputs, mode: str):
+    """The learned loss drives inner-loop adaptation (ref :426-448)."""
+    del variables, features, labels
+    return inference_outputs['learned_loss_value'], SpecStruct()
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """Outer loss: standard behavior cloning (ref :423-425)."""
+    action_labels = jnp.asarray(labels[self.label_key], jnp.float32)
+    predictions = inference_outputs['inference_output']
+    loss = self._outer_loss_multiplier * jnp.mean(
+        (predictions.astype(jnp.float32) - action_labels) ** 2)
+    return loss, SpecStruct()

@@ -1,0 +1,430 @@
+"""Watch-Try-Learn trial/retrial models (arXiv:1906.03352).
+
+Parity target: /root/reference/research/vrgripper/vrgripper_env_wtl_models.py
+(pack_wtl_meta_features :46, VRGripperEnvSimpleTrialModel :140 — low-dim
+state, VRGripperEnvVisionTrialModel :359 — vision). The trial model
+conditions on the demo episode; the retrial variant additionally embeds the
+first trial episode together with its success signal, which is carried in
+``condition/labels/success``.
+
+Meta feature layout (fixed sample counts; retrial uses 2 condition
+episodes: [demo, trial]):
+  condition/features/full_state_pose | image,gripper_pose
+  condition/labels/action, condition/labels/success
+  inference/features/*, labels: action [+ success]
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import mdn
+from tensor2robot_tpu.layers import tec
+from tensor2robot_tpu.layers import vision_layers
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research.vrgripper.episode_to_transitions import (
+    make_fixed_length,
+)
+from tensor2robot_tpu.research.vrgripper.vrgripper_env_meta_models import (
+    _FixedCountMetaModel,
+)
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+def pack_wtl_meta_features(state, prev_episode_data, timestep,
+                           fixed_length: int,
+                           num_condition_samples_per_task: int,
+                           vision: bool = False,
+                           deterministic_condition: bool = True
+                           ) -> Dict[str, np.ndarray]:
+  """State + conditioning episodes -> WTL meta feed dict (ref :46-136)."""
+  del timestep
+  if len(prev_episode_data) < 1:
+    raise ValueError(
+        'prev_episode_data should at least contain one (demo) episode.')
+
+  def _get(obj, key):
+    return obj[key] if isinstance(obj, dict) else getattr(obj, key)
+
+  features = {}
+  if vision:
+    image = np.asarray(_get(state, 'image'))
+    pose = np.asarray(_get(state, 'pose'), np.float32)
+    features['inference/features/image'] = np.tile(
+        image[None], (fixed_length,) + (1,) * image.ndim).astype(np.uint8)
+    features['inference/features/gripper_pose'] = np.tile(
+        pose[None], (fixed_length,) + (1,) * pose.ndim)
+  else:
+    full_state = np.asarray(_get(state, 'full_state_pose'), np.float32)
+    features['inference/features/full_state_pose'] = np.tile(
+        full_state[None], (fixed_length,) + (1,) * full_state.ndim)
+
+  packed = {k: [] for k in ('image', 'gripper_pose', 'full_state_pose',
+                            'action', 'success')}
+  for i in range(num_condition_samples_per_task):
+    episode = make_fixed_length(
+        prev_episode_data[i % len(prev_episode_data)], fixed_length,
+        randomized=not deterministic_condition)
+    if vision:
+      packed['image'].append(np.stack(
+          [np.asarray(_get(t[0], 'image')) for t in episode]))
+      packed['gripper_pose'].append(np.stack(
+          [np.asarray(_get(t[0], 'pose'), np.float32) for t in episode]))
+    else:
+      packed['full_state_pose'].append(np.stack(
+          [np.asarray(_get(t[0], 'full_state_pose'), np.float32)
+           for t in episode]))
+    packed['action'].append(np.stack(
+        [np.asarray(t[1], np.float32) for t in episode]))
+    cumulative_return = np.sum([t[2] for t in episode])
+    packed['success'].append(
+        float(cumulative_return > 0) * np.ones((fixed_length, 1),
+                                               np.float32))
+  if vision:
+    features['condition/features/image'] = np.stack(
+        packed['image']).astype(np.uint8)
+    features['condition/features/gripper_pose'] = np.stack(
+        packed['gripper_pose'])
+  else:
+    features['condition/features/full_state_pose'] = np.stack(
+        packed['full_state_pose'])
+  features['condition/labels/action'] = np.stack(packed['action'])
+  features['condition/labels/success'] = np.stack(packed['success'])
+  for key in list(features):
+    if key.startswith('inference/'):
+      features[key] = features[key][None]
+    features[key] = features[key][None]
+  return features
+
+
+class _SimpleTrialNet(nn.Module):
+  """Low-dim WTL policy (ref VRGripperEnvSimpleTrialModel :216-288)."""
+
+  action_size: int
+  episode_length: int
+  fc_embed_size: int
+  ignore_embedding: bool
+  num_mixture_components: int
+  retrial: bool
+  embed_type: str
+
+  @nn.compact
+  def __call__(self, features, labels=None, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    inf_pose = jnp.asarray(
+        features['inference/features/full_state_pose'], jnp.float32)
+    con_pose = jnp.asarray(
+        features['condition/features/full_state_pose'], jnp.float32)
+    # Success labels [0, 1] -> [-1, 1] (ref :227).
+    con_success = 2.0 * jnp.asarray(
+        features['condition/labels/success'], jnp.float32) - 1.0
+    if self.retrial and con_pose.shape[1] != 2:
+      raise ValueError('Unexpected shape {}.'.format(con_pose.shape))
+
+    episode_length = inf_pose.shape[2]
+    if self.embed_type == 'temporal':
+      fc_embedding = meta_data.multi_batch_apply(
+          tec.ReduceTemporalEmbeddings(self.fc_embed_size,
+                                       name='demo_embedding'), 2,
+          con_pose[:, 0:1, :, :])[:, :, None, :]
+    elif self.embed_type == 'mean':
+      fc_embedding = con_pose[:, 0:1, -1:, :]
+    else:
+      raise ValueError('Invalid embed_type: {}.'.format(self.embed_type))
+    fc_embedding = jnp.broadcast_to(
+        fc_embedding,
+        fc_embedding.shape[:2] + (episode_length,) + fc_embedding.shape[-1:])
+
+    if self.retrial:
+      # Embed the trial episode with its success signal (ref :240-255).
+      con_input = jnp.concatenate(
+          [con_pose[:, 1:2, :, :], con_success[:, 1:2, :, :], fc_embedding],
+          -1)
+      if self.embed_type == 'mean':
+        trial_embedding = meta_data.multi_batch_apply(
+            tec.EmbedFullstate(self.fc_embed_size, name='trial_embedding'),
+            3, con_input)
+        trial_embedding = jnp.mean(trial_embedding, -2)
+      else:
+        trial_embedding = meta_data.multi_batch_apply(
+            tec.ReduceTemporalEmbeddings(self.fc_embed_size,
+                                         name='trial_embedding'), 2,
+            con_input)
+      trial_embedding = jnp.broadcast_to(
+          trial_embedding[:, :, None, :],
+          trial_embedding.shape[:2] + (episode_length,) +
+          trial_embedding.shape[-1:])
+      fc_embedding = jnp.concatenate([fc_embedding, trial_embedding], -1)
+
+    if self.ignore_embedding:
+      fc_inputs = inf_pose
+    else:
+      parts = [inf_pose, fc_embedding]
+      if self.retrial:
+        parts.append(con_success[:, 1:2, :, :])
+      fc_inputs = jnp.concatenate(parts, -1)
+
+    outputs = SpecStruct()
+    if self.num_mixture_components > 1:
+      hidden = meta_data.multi_batch_apply(
+          vision_layers.ImageFeaturesToPoseNet(
+              num_outputs=None, name='a_func'), 3, fc_inputs)
+      dist_params = mdn.MDNParamsLayer(
+          num_alphas=self.num_mixture_components,
+          sample_size=self.action_size, condition_sigmas=False,
+          name='mdn_head')(hidden)
+      outputs['dist_params'] = dist_params
+      gm = mdn.get_mixture_distribution(
+          dist_params.astype(jnp.float32), self.num_mixture_components,
+          self.action_size)
+      action = mdn.gaussian_mixture_approximate_mode(gm)
+      if labels is not None:
+        outputs['bc_loss'] = -jnp.mean(mdn.mixture_log_prob(
+            gm, jnp.asarray(labels['action'], jnp.float32)))
+    else:
+      action = meta_data.multi_batch_apply(
+          vision_layers.ImageFeaturesToPoseNet(
+              num_outputs=self.action_size, name='a_func'), 3, fc_inputs)
+      if labels is not None:
+        outputs['bc_loss'] = jnp.mean(
+            (action.astype(jnp.float32) -
+             jnp.asarray(labels['action'], jnp.float32)) ** 2)
+    outputs['inference_output'] = action
+    return outputs
+
+
+class VRGripperEnvSimpleTrialModel(_FixedCountMetaModel):
+  """Low-dim-state WTL trial/retrial model (ref :140-355)."""
+
+  def __init__(self,
+               action_size: int = 7,
+               fc_embed_size: int = 32,
+               ignore_embedding: bool = False,
+               num_mixture_components: int = 1,
+               retrial: bool = False,
+               embed_type: str = 'temporal',
+               obs_size: int = 32,
+               **kwargs):
+    if retrial:
+      kwargs.setdefault('num_condition_samples_per_task', 2)
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._fc_embed_size = fc_embed_size
+    self._ignore_embedding = ignore_embedding
+    self._num_mixture_components = num_mixture_components
+    self._retrial = retrial
+    self._embed_type = embed_type
+    self._obs_size = obs_size
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    """ref :168-178."""
+    del mode
+    return SpecStruct(full_state_pose=TensorSpec(
+        (self._episode_length, self._obs_size), np.float32,
+        name='full_state_pose'))
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    """ref :180-190."""
+    del mode
+    return SpecStruct(
+        action=TensorSpec((self._episode_length, self._action_size),
+                          np.float32, name='action_world'),
+        success=TensorSpec((self._episode_length, 1), np.float32,
+                           name='success'))
+
+  def create_network(self) -> nn.Module:
+    return _SimpleTrialNet(
+        action_size=self._action_size,
+        episode_length=self._episode_length,
+        fc_embed_size=self._fc_embed_size,
+        ignore_embedding=self._ignore_embedding,
+        num_mixture_components=self._num_mixture_components,
+        retrial=self._retrial,
+        embed_type=self._embed_type)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """ref :290-312."""
+    bc_loss = inference_outputs['bc_loss']
+    return bc_loss, SpecStruct(bc_loss=bc_loss)
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, train_outputs = self.model_train_fn(
+        variables, features, labels, inference_outputs, mode)
+    metrics = SpecStruct(loss=loss)
+    for key in train_outputs:
+      metrics['mean_' + key] = train_outputs[key]
+    return metrics
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    """ref :335-355."""
+    return pack_wtl_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition)
+
+
+class _VisionTrialNet(nn.Module):
+  """Vision WTL policy (ref VRGripperEnvVisionTrialModel :435-505)."""
+
+  action_size: int
+  episode_length: int
+  fc_embed_size: int
+  ignore_embedding: bool
+  num_mixture_components: int
+  num_condition_samples_per_task: int
+
+  def _embed_episode(self, episode_images, gripper_pose, success, train):
+    """Demo (+trial w/ success) embedding (ref :435-462)."""
+    # One shared image embedder (the reference's AUTO_REUSE
+    # 'image_embedding' scope serves both demo and trial frames).
+    embedder = tec.EmbedConditionImages(name='image_embedding')
+    demo_fp = meta_data.multi_batch_apply(
+        lambda im: embedder(im, train=train), 3, episode_images[:, 0:1])
+    demo_inputs = jnp.concatenate([demo_fp, gripper_pose[:, 0:1]], -1)
+    embedding = meta_data.multi_batch_apply(
+        tec.ReduceTemporalEmbeddings(self.fc_embed_size,
+                                     name='fc_demo_reduce'), 2, demo_inputs)
+    if self.num_condition_samples_per_task > 1:
+      con_success = 2.0 * success - 1.0
+      trial_fp = meta_data.multi_batch_apply(
+          lambda im: embedder(im, train=train), 3, episode_images[:, 1:2])
+      episode_length = episode_images.shape[2]
+      trial_inputs = jnp.concatenate(
+          [trial_fp, gripper_pose[:, 1:2], con_success[:, 1:2],
+           jnp.broadcast_to(
+               embedding[:, :, None, :],
+               embedding.shape[:2] + (episode_length,) +
+               embedding.shape[-1:])], -1)
+      trial_embedding = meta_data.multi_batch_apply(
+          tec.ReduceTemporalEmbeddings(self.fc_embed_size,
+                                       name='fc_trial_reduce'), 2,
+          trial_inputs)
+      embedding = jnp.concatenate([embedding, trial_embedding], axis=-1)
+    return embedding
+
+  @nn.compact
+  def __call__(self, features, labels=None, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    condition_images = jnp.asarray(
+        features['condition/features/image'], jnp.float32)
+    con_gripper = jnp.asarray(
+        features['condition/features/gripper_pose'], jnp.float32)
+    con_success = jnp.asarray(
+        features['condition/labels/success'], jnp.float32)
+    inference_images = jnp.asarray(
+        features['inference/features/image'], jnp.float32)
+    gripper_pose = jnp.asarray(
+        features['inference/features/gripper_pose'], jnp.float32)
+
+    condition_embedding = self._embed_episode(
+        condition_images, con_gripper, con_success, train)
+    fc_embedding = jnp.broadcast_to(
+        condition_embedding[:, :, None, :],
+        condition_embedding.shape[:2] + (self.episode_length,) +
+        condition_embedding.shape[-1:])
+    state_features, _ = meta_data.multi_batch_apply(
+        lambda im: vision_layers.ImagesToFeaturesNet(
+            name='state_features')(im, train=train), 3, inference_images)
+    if self.ignore_embedding:
+      fc_inputs = jnp.concatenate([state_features, gripper_pose], -1)
+    else:
+      fc_inputs = jnp.concatenate(
+          [state_features, gripper_pose, fc_embedding], -1)
+
+    outputs = SpecStruct()
+    if self.num_mixture_components > 1:
+      dist_params = mdn.MDNParamsLayer(
+          num_alphas=self.num_mixture_components,
+          sample_size=self.action_size, condition_sigmas=False,
+          name='mdn_head')(fc_inputs)
+      outputs['dist_params'] = dist_params
+      gm = mdn.get_mixture_distribution(
+          dist_params.astype(jnp.float32), self.num_mixture_components,
+          self.action_size)
+      action = mdn.gaussian_mixture_approximate_mode(gm)
+      if labels is not None:
+        outputs['bc_loss'] = -jnp.mean(mdn.mixture_log_prob(
+            gm, jnp.asarray(labels['action'], jnp.float32)))
+    else:
+      action = meta_data.multi_batch_apply(
+          vision_layers.ImageFeaturesToPoseNet(
+              num_outputs=self.action_size, name='a_func'), 3, fc_inputs)
+      if labels is not None:
+        outputs['bc_loss'] = jnp.mean(
+            (action.astype(jnp.float32) -
+             jnp.asarray(labels['action'], jnp.float32)) ** 2)
+    outputs['inference_output'] = action
+    return outputs
+
+
+class VRGripperEnvVisionTrialModel(_FixedCountMetaModel):
+  """Vision WTL trial/retrial model (ref :359-574)."""
+
+  def __init__(self,
+               action_size: int = 7,
+               embed_loss_weight: float = 0.0,
+               fc_embed_size: int = 32,
+               ignore_embedding: bool = False,
+               num_mixture_components: int = 1,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._embed_loss_weight = embed_loss_weight
+    self._fc_embed_size = fc_embed_size
+    self._ignore_embedding = ignore_embedding
+    self._num_mixture_components = num_mixture_components
+
+  def _episode_feature_specification(self, mode: str) -> SpecStruct:
+    """ref :384-397."""
+    del mode
+    return SpecStruct(
+        image=TensorSpec((self._episode_length, 100, 100, 3), np.float32,
+                         name='image0', data_format='jpeg'),
+        gripper_pose=TensorSpec((self._episode_length, 14), np.float32,
+                                name='world_pose_gripper'))
+
+  def _episode_label_specification(self, mode: str) -> SpecStruct:
+    """ref :399-409."""
+    del mode
+    return SpecStruct(
+        action=TensorSpec((self._episode_length, self._action_size),
+                          np.float32, name='action_world'),
+        success=TensorSpec((self._episode_length, 1), np.float32,
+                           name='success'))
+
+  def create_network(self) -> nn.Module:
+    return _VisionTrialNet(
+        action_size=self._action_size,
+        episode_length=self._episode_length,
+        fc_embed_size=self._fc_embed_size,
+        ignore_embedding=self._ignore_embedding,
+        num_mixture_components=self._num_mixture_components,
+        num_condition_samples_per_task=self._num_condition)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    """ref :507-530."""
+    bc_loss = inference_outputs['bc_loss']
+    return bc_loss, SpecStruct(bc_loss=bc_loss)
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, train_outputs = self.model_train_fn(
+        variables, features, labels, inference_outputs, mode)
+    metrics = SpecStruct(loss=loss)
+    for key in train_outputs:
+      metrics['mean_' + key] = train_outputs[key]
+    return metrics
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    """ref :553-574."""
+    return pack_wtl_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition, vision=True)

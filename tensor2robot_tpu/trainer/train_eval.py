@@ -76,7 +76,15 @@ class Trainer:
                save_checkpoints_steps: int = 500,
                async_checkpoints: bool = True,
                log_every_n_steps: int = 100,
-               use_avg_params_for_eval: Optional[bool] = None):
+               use_avg_params_for_eval: Optional[bool] = None,
+               write_metrics: bool = True,
+               eval_name: Optional[str] = None,
+               profile_steps: Optional[Sequence[int]] = None):
+    """write_metrics: emit TensorBoard events (train scalars under
+    model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
+    per-eval-run dirs, ref utils/train_eval.py:539-547).
+    profile_steps: (start, stop) global steps bracketing ONE
+    jax.profiler trace written under model_dir/plugins (SURVEY §5)."""
     self.model = model
     self.model_dir = model_dir
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
@@ -99,6 +107,49 @@ class Trainer:
     self._predict_step_fn = None
     self._throughput = None  # (examples/sec, step_time_s) from last train run
     self.last_eval_state = None  # state used by the most recent evaluate()
+    self._write_metrics = write_metrics
+    self._eval_name = eval_name
+    self._profile_steps = tuple(profile_steps) if profile_steps else None
+    self._profiling = False
+    self._train_writer = None
+    self._eval_writer = None
+
+  @property
+  def train_metrics_writer(self):
+    """Lazy TensorBoard writer for the train run (None when disabled)."""
+    if self._write_metrics and self._train_writer is None:
+      from tensor2robot_tpu.trainer.metrics import MetricsWriter
+      self._train_writer = MetricsWriter(self.model_dir)
+    return self._train_writer
+
+  @property
+  def eval_metrics_writer(self):
+    if self._write_metrics and self._eval_writer is None:
+      from tensor2robot_tpu.trainer.metrics import MetricsWriter
+      subdir = ('eval_' + self._eval_name) if self._eval_name else 'eval'
+      self._eval_writer = MetricsWriter(os.path.join(self.model_dir, subdir))
+    return self._eval_writer
+
+  def _maybe_profile(self, step_i: int) -> None:
+    """Starts/stops the one configured jax.profiler trace window."""
+    if self._profile_steps is None:
+      return
+    start, stop = self._profile_steps
+    if not self._profiling and step_i >= start and step_i < stop:
+      try:
+        # start_trace appends plugins/profile/<run> itself — pass the
+        # logdir root so TensorBoard's profile plugin finds the trace.
+        jax.profiler.start_trace(self.model_dir)
+        self._profiling = True
+      except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        _log('Profiler unavailable: %s', e)
+        self._profile_steps = None
+    elif self._profiling and step_i >= stop:
+      jax.profiler.stop_trace()
+      self._profiling = False
+      self._profile_steps = None
+      _log('Profiler trace written to %s',
+           os.path.join(self.model_dir, 'plugins', 'profile'))
 
   # -- state ---------------------------------------------------------------
 
@@ -247,6 +298,7 @@ class Trainer:
     step_i = start_step
     batch = (features, labels)
     while step_i < max_train_steps:
+      self._maybe_profile(step_i)
       features, labels = batch
       device_batch = sharding_lib.shard_batch(
           {'features': features.to_dict(),
@@ -263,6 +315,15 @@ class Trainer:
         self._throughput = (examples_per_sec, dt / max(steps_since_log, 1))
         _log('step %d: loss=%s (%.1f examples/sec)', step_i,
              metrics.get('loss'), examples_per_sec)
+        writer = self.train_metrics_writer
+        if writer is not None:
+          scalars = {k: float(np.mean(v)) for k, v in metrics.items()
+                     if np.ndim(v) == 0}
+          scalars['global_step/sec'] = 1.0 / max(
+              dt / max(steps_since_log, 1), 1e-9)
+          scalars['examples/sec'] = examples_per_sec
+          writer.write_scalars(step_i, scalars)
+          writer.flush()
         t_last = time.time()
         steps_since_log = 0
       if step_i % self.save_checkpoints_steps == 0:
@@ -271,6 +332,9 @@ class Trainer:
         hook.after_step(self, state, step_i, metrics)
       if step_i < max_train_steps:
         batch = next(iterator)
+    if self._profiling:
+      jax.profiler.stop_trace()
+      self._profiling = False
     self.save_checkpoint(state, force=True)
     for hook in hooks:
       hook.end(self, state)
@@ -309,7 +373,12 @@ class Trainer:
       for key, value in metrics.items():
         totals[key] = totals.get(key, 0.0) + float(np.mean(value))
       count += 1
-    return {k: v / max(count, 1) for k, v in totals.items()}
+    averaged = {k: v / max(count, 1) for k, v in totals.items()}
+    writer = self.eval_metrics_writer
+    if writer is not None:
+      writer.write_scalars(int(jax.device_get(state.step)), averaged)
+      writer.flush()
+    return averaged
 
   def predict(self, state: TrainState, features: SpecStruct
               ) -> Dict[str, np.ndarray]:
@@ -333,7 +402,9 @@ class Trainer:
       assets_lib.write_t2r_assets_to_file(
           self.model.get_feature_specification(ModeKeys.TRAIN),
           self.model.get_label_specification(ModeKeys.TRAIN),
-          step, os.path.join(self.model_dir, 'assets.extra'))
+          step, os.path.join(self.model_dir,
+                             assets_lib.EXTRA_ASSETS_DIRECTORY,
+                             assets_lib.T2R_ASSETS_FILENAME))
 
   @property
   def last_throughput(self):
@@ -342,6 +413,26 @@ class Trainer:
   def close(self) -> None:
     self.checkpoint_manager.wait_until_finished()
     self.checkpoint_manager.close()
+    for writer in (self._train_writer, self._eval_writer):
+      if writer is not None:
+        writer.close()
+    self._train_writer = self._eval_writer = None
+
+
+def _maybe_snapshot_config(model_dir: str,
+                           filename: str = 'config_snapshot.gin',
+                           operative: bool = False) -> None:
+  """Writes the active config bindings into model_dir (the reference's
+  GinConfigSaverHook, ref models/abstract_model.py:762-764)."""
+  try:
+    from tensor2robot_tpu.config import ginlike
+    text = (ginlike.operative_config_str() if operative
+            else ginlike.config_str())
+    if text.strip():
+      with open(os.path.join(model_dir, filename), 'w') as f:
+        f.write(text)
+  except Exception as e:  # noqa: BLE001 — snapshots must never kill a run
+    _log('Config snapshot (%s) failed: %s', filename, e)
 
 
 def train_eval_model(t2r_model: AbstractT2RModel,
@@ -359,7 +450,11 @@ def train_eval_model(t2r_model: AbstractT2RModel,
                      save_checkpoints_steps: int = 500,
                      async_checkpoints: bool = True,
                      seed: int = 0,
-                     eval_timeout_secs: float = 30.0) -> Dict[str, Any]:
+                     eval_timeout_secs: float = 30.0,
+                     write_metrics: bool = True,
+                     eval_name: Optional[str] = None,
+                     profile_steps: Optional[Sequence[int]] = None
+                     ) -> Dict[str, Any]:
   """Main entry point (ref utils/train_eval.py:404).
 
   Modes, mirroring the reference's Estimator dispatch:
@@ -380,7 +475,11 @@ def train_eval_model(t2r_model: AbstractT2RModel,
       t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp, seed=seed,
       keep_checkpoint_max=keep_checkpoint_max,
       save_checkpoints_steps=save_checkpoints_steps,
-      async_checkpoints=async_checkpoints)
+      async_checkpoints=async_checkpoints,
+      write_metrics=write_metrics,
+      eval_name=eval_name,
+      profile_steps=profile_steps)
+  _maybe_snapshot_config(model_dir)
 
   hooks: List[Any] = []
   for builder in train_hook_builders:
@@ -421,5 +520,6 @@ def train_eval_model(t2r_model: AbstractT2RModel,
     else:
       raise ValueError('Provide at least one of train/eval input generators.')
   finally:
+    _maybe_snapshot_config(model_dir, 'operative_config.gin', operative=True)
     trainer.close()
   return {'state': state, 'eval_metrics': eval_metrics, 'trainer': trainer}

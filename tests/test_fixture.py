@@ -1,0 +1,31 @@
+"""T2RModelFixture tests (the reference's t2r_test_fixture contract)."""
+
+import numpy as np
+
+from tensor2robot_tpu.research.pose_env import PoseEnvRegressionModel
+from tensor2robot_tpu.utils.mocks import MockT2RModel
+from tensor2robot_tpu.utils.t2r_test_fixture import (
+    T2RModelFixture,
+    assert_output_files,
+)
+
+
+class TestFixture:
+
+  def test_random_train_and_predict_mock_model(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path), batch_size=16)
+    result = fixture.random_train(
+        MockT2RModel(use_batch_norm=False, device_type='cpu'),
+        max_train_steps=2)
+    assert_output_files(result['model_dir'])
+    outputs = fixture.random_predict(
+        MockT2RModel(use_batch_norm=False, device_type='cpu'),
+        result['model_dir'])
+    assert 'logits' in outputs
+
+  def test_real_model_restore_predict_parity(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path), batch_size=8)
+    result = fixture.random_train(PoseEnvRegressionModel(),
+                                  max_train_steps=2)
+    fixture.restore_predict_parity(PoseEnvRegressionModel,
+                                   result['model_dir'])

@@ -1,0 +1,232 @@
+"""Grasp2Vec stack tests.
+
+Loss numerics mirror /root/reference/research/grasp2vec/losses_test.py
+(value-level checks against independent numpy math, incl. a brute-force
+semi-hard triplet oracle); the model trains end-to-end on the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu.data.input_generators import DefaultRandomInputGenerator
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.research import grasp2vec
+from tensor2robot_tpu.research.grasp2vec import losses, visualization
+from tensor2robot_tpu.specs import generators as spec_generators
+from tensor2robot_tpu.trainer import Trainer
+
+EMBEDDING = 32
+BATCH_SIZE = 8
+_RNG = np.random.RandomState(0)
+FAKE = {
+    'pregrasp': _RNG.random_sample((BATCH_SIZE, EMBEDDING)),
+    'postgrasp': _RNG.random_sample((BATCH_SIZE, EMBEDDING)),
+    'goal': _RNG.random_sample((BATCH_SIZE, EMBEDDING)),
+}
+
+
+def _cosine_distance(x, y):
+  dots = np.sum(x * y, axis=1)
+  return 1 - dots / (np.linalg.norm(x, axis=1) * np.linalg.norm(y, axis=1))
+
+
+class TestArithmeticLosses:
+
+  def test_cosine_arithmetic_loss_zeros_mask(self):
+    loss = losses.cosine_arithmetic_loss(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'],
+        np.zeros(BATCH_SIZE))
+    assert float(loss) == 0.0
+
+  def test_cosine_arithmetic_loss_ones_mask(self):
+    loss = losses.cosine_arithmetic_loss(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'],
+        np.ones(BATCH_SIZE))
+    expected = np.mean(_cosine_distance(
+        FAKE['pregrasp'] - FAKE['postgrasp'], FAKE['goal']))
+    np.testing.assert_allclose(float(loss), expected, atol=1e-3)
+
+  def test_cosine_arithmetic_loss_mixed_mask(self):
+    mask = np.zeros(BATCH_SIZE)
+    mask[0] = 1
+    loss = losses.cosine_arithmetic_loss(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'], mask)
+    expected = _cosine_distance(
+        FAKE['pregrasp'] - FAKE['postgrasp'], FAKE['goal'])[0]
+    np.testing.assert_allclose(float(loss), expected, atol=1e-3)
+
+  def test_l2_arithmetic_loss_value(self):
+    loss = losses.l2_arithmetic_loss(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'],
+        np.ones(BATCH_SIZE))
+    expected = np.mean(np.sum(
+        (FAKE['pregrasp'] - FAKE['goal'] - FAKE['postgrasp']) ** 2, axis=1))
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+  def test_send_to_zero_loss(self):
+    mask = np.zeros(BATCH_SIZE)
+    mask[:2] = 1
+    loss = losses.send_to_zero_loss(FAKE['goal'], mask)
+    expected = np.mean(np.linalg.norm(FAKE['goal'][:2], axis=1))
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+
+class TestNPairs:
+
+  def test_npairs_loss_value(self):
+    """Matches the slim formula computed independently in numpy."""
+    anchor = FAKE['pregrasp'] - FAKE['postgrasp']
+    positive = FAKE['goal']
+    labels = np.arange(BATCH_SIZE)
+    loss = losses.npairs_loss(labels, anchor, positive)
+    similarity = anchor @ positive.T
+    lse = np.log(np.sum(np.exp(similarity), axis=1))
+    xent = np.mean(lse - np.diag(similarity))
+    reg = 0.25 * 0.002 * (np.mean(np.sum(anchor ** 2, 1)) +
+                          np.mean(np.sum(positive ** 2, 1)))
+    np.testing.assert_allclose(float(loss), xent + reg, rtol=1e-4)
+
+  def test_n_pairs_loss_is_symmetric_sum(self):
+    loss = losses.n_pairs_loss(FAKE['pregrasp'], FAKE['goal'],
+                               FAKE['postgrasp'])
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+  def test_n_pairs_loss_multilabel_finite(self):
+    success = np.ones((BATCH_SIZE, 1))
+    success[1] = 0
+    loss = losses.n_pairs_loss_multilabel(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'], success)
+    assert np.isfinite(float(loss))
+
+
+def _brute_force_semihard(labels, embeddings, margin):
+  """Literal per-pair oracle for slim's semi-hard triplet loss."""
+  n = len(labels)
+  d = np.zeros((n, n))
+  for i in range(n):
+    for j in range(n):
+      d[i, j] = np.sum((embeddings[i] - embeddings[j]) ** 2)
+  total, count = 0.0, 0
+  for i in range(n):
+    for j in range(n):
+      if i == j or labels[i] != labels[j]:
+        continue
+      negatives = [k for k in range(n) if labels[k] != labels[i]]
+      outside = [d[i, k] for k in negatives if d[i, k] > d[i, j]]
+      if outside:
+        d_in = min(outside)
+      else:
+        d_in = max(d[i, k] for k in negatives)
+      total += max(margin + d[i, j] - d_in, 0.0)
+      count += 1
+  return total / max(count, 1e-16)
+
+
+class TestTriplet:
+
+  def test_semihard_matches_brute_force(self):
+    rng = np.random.RandomState(3)
+    embeddings = rng.randn(10, 4).astype(np.float32)
+    labels = np.array([0, 0, 1, 1, 2, 2, 3, 3, 4, 4])
+    loss = losses.triplet_semihard_loss(labels, embeddings, margin=1.0)
+    expected = _brute_force_semihard(labels, embeddings, margin=1.0)
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-4)
+
+  def test_triplet_loss_shapes(self):
+    loss, pairs, labels = losses.triplet_loss(
+        FAKE['pregrasp'], FAKE['goal'], FAKE['postgrasp'])
+    assert pairs.shape == (2 * BATCH_SIZE, EMBEDDING)
+    assert labels.shape == (2 * BATCH_SIZE,)
+    assert np.isfinite(float(loss))
+
+
+class TestAuxLosses:
+
+  def test_keypoint_accuracy_perfect(self):
+    centers = np.array([[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]],
+                       np.float32)
+    accuracy, loss = losses.keypoint_accuracy(centers, np.arange(4))
+    assert float(accuracy) == 1.0
+    assert np.isfinite(float(loss))
+
+  def test_ty_loss_prefers_pregrasp_response(self):
+    goal = np.zeros((1, 4), np.float32)
+    goal[0, 0] = 1.0
+    pre = np.zeros((1, 2, 2, 4), np.float32)
+    pre[0, 0, 0, 0] = 1.0  # object present in pregrasp
+    post = np.zeros((1, 2, 2, 4), np.float32)
+    post[0, :, :, 1] = 1.0  # absent in postgrasp
+    loss = losses.ty_loss(pre, post, goal)
+    assert float(loss) < 0  # post response < pre response
+
+  def test_match_norms_loss(self):
+    loss = losses.match_norms_loss(FAKE['pregrasp'], 2 * FAKE['pregrasp'])
+    assert float(loss) > 0
+
+  def test_get_softmax_response_detects_presence(self):
+    goal = np.zeros((1, 4), np.float32)
+    goal[0, 0] = 1.0
+    scene = np.zeros((1, 3, 3, 4), np.float32)
+    scene[0, 1, 1, 0] = 5.0
+    max_heat, max_soft = losses.get_softmax_response(goal, scene)
+    np.testing.assert_allclose(float(max_heat[0]), 5.0)
+    assert 0 < float(max_soft[0]) <= 1.0
+
+
+class TestVisualization:
+
+  def test_heatmap_and_keypoints_pipeline(self):
+    outputs = {
+        'goal_vector': FAKE['goal'][:2, :4].astype(np.float32),
+        'pre_spatial': _RNG.rand(2, 5, 5, 4).astype(np.float32),
+        'pre_vector': FAKE['pregrasp'][:2, :4].astype(np.float32),
+        'post_vector': FAKE['postgrasp'][:2, :4].astype(np.float32),
+    }
+    features = {'pregrasp_image': _RNG.rand(2, 16, 16, 3).astype(np.float32)}
+    summaries = visualization.grasp2vec_summaries(features, outputs)
+    assert summaries['goal_pregrasp_map'].shape == (2, 5, 5, 1)
+    assert summaries['keypoints'].shape == (2, 16, 16, 3)
+    assert 'hist/correct_distances' in summaries
+    softmax = summaries['goal_pregrasp_map_softmax']
+    np.testing.assert_allclose(softmax.reshape(2, -1).sum(1), 1.0, rtol=1e-4)
+
+
+class TestGrasp2VecModel:
+
+  def test_trains_and_embedding_arithmetic_shapes(self, tmp_path):
+    """ResNet tower trains on the mesh; embeddings have matching dims."""
+    model = grasp2vec.Grasp2VecModel(
+        scene_size=(56, 56), goal_size=(56, 56), resnet_size=18,
+        preprocessor_cls=lambda f, l: grasp2vec.Grasp2VecPreprocessor(
+            f, l, scene_crop=(0, 8, 56, 0, 8, 56),
+            goal_crop=(0, 8, 56, 0, 8, 56), src_img_shape=(64, 64, 3)))
+    generator = DefaultRandomInputGenerator(batch_size=8)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=1)
+    state = trainer.train(generator, max_train_steps=2)
+    trainer.close()
+    assert int(jax.device_get(state.step)) == 2
+
+  def test_preprocessor_shared_scene_crop(self):
+    model = grasp2vec.Grasp2VecModel(
+        scene_size=(56, 56), goal_size=(56, 56), resnet_size=18,
+        preprocessor_cls=lambda f, l: grasp2vec.Grasp2VecPreprocessor(
+            f, l, scene_crop=(0, 8, 56, 0, 8, 56),
+            goal_crop=(0, 8, 56, 0, 8, 56), src_img_shape=(64, 64, 3)))
+    pre = model.preprocessor
+    in_spec = pre.get_in_feature_specification(ModeKeys.TRAIN)
+    assert tuple(in_spec['pregrasp_image'].shape) == (64, 64, 3)
+    features = spec_generators.make_random_numpy(in_spec, batch_size=2)
+    # Identical content in pre/post images stays identical after the
+    # (shared) scene crop.
+    features['postgrasp_image'] = features['pregrasp_image'].copy()
+    out, _ = pre.preprocess(features, None, ModeKeys.TRAIN,
+                            rng=jax.random.PRNGKey(0))
+    assert np.asarray(out['pregrasp_image']).shape == (2, 56, 56, 3)
+    # Flips are per-image-key, so compare before flipping via EVAL mode.
+    out_eval, _ = pre.preprocess(features, None, ModeKeys.EVAL, rng=None)
+    np.testing.assert_array_equal(np.asarray(out_eval['pregrasp_image']),
+                                  np.asarray(out_eval['postgrasp_image']))
