@@ -117,7 +117,7 @@ def main():
   model = Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
       device_type='tpu' if on_tpu else 'cpu')
 
-  candidate_batches = [256, 128, 64, 32] if on_tpu else [8]
+  candidate_batches = [512, 256, 128, 64, 32] if on_tpu else [8]
   n_steps = 20 if on_tpu else 2
   mesh = parallel.create_mesh()
 

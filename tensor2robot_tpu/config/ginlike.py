@@ -370,12 +370,32 @@ class _ValueParser:
       return token  # bare identifier -> string (gin tolerates for enums)
 
 
+def _strip_comment(line: str) -> str:
+  """Removes a trailing # comment, ignoring # inside quoted strings."""
+  quote = None
+  i = 0
+  while i < len(line):
+    ch = line[i]
+    if quote:
+      if ch == '\\':
+        i += 2
+        continue
+      if ch == quote:
+        quote = None
+    elif ch in '\'"':
+      quote = ch
+    elif ch == '#':
+      return line[:i]
+    i += 1
+  return line
+
+
 def _logical_lines(text: str):
   """Joins continuation lines (open brackets or trailing backslash)."""
   pending = ''
   depth = 0
   for raw_line in text.splitlines():
-    line = raw_line.split('#', 1)[0].rstrip()
+    line = _strip_comment(raw_line).rstrip()
     if not line.strip() and not pending:
       continue
     pending = (pending + '\n' + line) if pending else line

@@ -33,11 +33,66 @@ from tensor2robot_tpu.data.pipeline import (
 from tensor2robot_tpu.modes import ModeKeys, assert_valid_mode
 
 
+def prefetch_iterator(iterator: Iterator, depth: int) -> Iterator:
+  """Wraps an iterator with a ``depth``-deep background prefetch queue.
+
+  Producer uses timed puts against a stop event (same discipline as
+  BatchedExampleStream, data/pipeline.py): when the consumer abandons or
+  closes the generator, the worker thread exits instead of blocking in
+  q.put forever holding decoded batches and open readers.
+  """
+  import queue
+  import threading
+
+  q: 'queue.Queue' = queue.Queue(maxsize=depth)
+  sentinel = object()
+  error: list = []
+  stop = threading.Event()
+
+  def _put(item) -> bool:
+    while not stop.is_set():
+      try:
+        q.put(item, timeout=0.1)
+        return True
+      except queue.Full:
+        continue
+    return False
+
+  def _producer():
+    try:
+      for item in iterator:
+        if not _put(item):
+          return
+    except BaseException as e:  # surfaced on the consumer side
+      error.append(e)
+    finally:
+      _put(sentinel)
+
+  thread = threading.Thread(target=_producer, daemon=True,
+                            name='t2r-prefetch')
+  thread.start()
+
+  def _consume():
+    try:
+      while True:
+        item = q.get()
+        if item is sentinel:
+          if error:
+            raise error[0]
+          return
+        yield item
+    finally:
+      stop.set()
+
+  return _consume()
+
+
 class AbstractInputGenerator(abc.ABC):
   """Binds a model's (preprocessor's) in-specs to a batch source."""
 
-  def __init__(self, batch_size: int = 32):
+  def __init__(self, batch_size: int = 32, prefetch: int = 2):
     self._batch_size = int(batch_size)
+    self._prefetch = int(prefetch)
     self._feature_spec = None
     self._label_spec = None
     self._preprocess_fn = None
@@ -79,16 +134,27 @@ class AbstractInputGenerator(abc.ABC):
       self, mode: str,
       num_epochs: Optional[int] = None,
       shard_index: int = 0, num_shards: int = 1,
-      seed: Optional[int] = None) -> Iterator:
-    """Yields (features, labels) numpy batch SpecStructs."""
+      seed: Optional[int] = None,
+      prefetch: Optional[int] = None) -> Iterator:
+    """Yields (features, labels) numpy batch SpecStructs.
+
+    ``prefetch``: batches decoded ahead in a background thread so host
+    parsing overlaps the device step (the reference's
+    prefetch(AUTOTUNE), utils/tfdata.py:575). None uses the generator's
+    default; 0 disables.
+    """
     assert_valid_mode(mode)
     if self._feature_spec is None:
       raise ValueError(
           'set_specification(_from_model) must be called before creating '
           'a dataset iterator.')
-    return self._create_iterator(mode=mode, num_epochs=num_epochs,
-                                 shard_index=shard_index,
-                                 num_shards=num_shards, seed=seed)
+    iterator = self._create_iterator(mode=mode, num_epochs=num_epochs,
+                                     shard_index=shard_index,
+                                     num_shards=num_shards, seed=seed)
+    depth = self._prefetch if prefetch is None else prefetch
+    if depth and depth > 0:
+      iterator = prefetch_iterator(iterator, depth)
+    return iterator
 
   @abc.abstractmethod
   def _create_iterator(self, mode: str, num_epochs, shard_index, num_shards,
@@ -136,11 +202,14 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       raise ValueError(
           'Specs reference dataset keys {} with no configured files; have {}.'
           .format(sorted(missing), sorted(datasets)))
+    # prefetch=0: the base class's prefetch_iterator wrapper is the ONE
+    # background-decode mechanism (stacking the stream's own worker on top
+    # would double the threads and the buffered-batch memory).
     stream = BatchedExampleStream(
         datasets, parser, batch_size=self._batch_size,
         shuffle=(mode == ModeKeys.TRAIN),
         shuffle_buffer=self._shuffle_buffer_size,
-        num_epochs=num_epochs, seed=seed, prefetch=self._prefetch)
+        num_epochs=num_epochs, seed=seed, prefetch=0)
     return iter(stream)
 
 

@@ -21,9 +21,26 @@ casts excepted, which are fused into the first device op by XLA).
 
 from __future__ import annotations
 
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
+
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def _decode_pool() -> ThreadPoolExecutor:
+  """Shared decode pool, sized to the host's cores (lazy, fork-safe-ish)."""
+  global _POOL
+  with _POOL_LOCK:
+    if _POOL is None:
+      _POOL = ThreadPoolExecutor(
+          max_workers=min(16, (os.cpu_count() or 4)),
+          thread_name_prefix='t2r-decode')
+    return _POOL
 
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import wire
@@ -214,7 +231,14 @@ class ExampleParser:
       records = [{k: serialized_batch[k][i] for k in keys} for i in range(n)]
     else:
       records = list(serialized_batch)
-    parsed = [self.parse_single(r) for r in records]
+    # JPEG decode dominates the host path (SURVEY §7 hard-part #3) and cv2
+    # releases the GIL, so per-record parsing fans out over a thread pool
+    # (the reference's tf.data num_parallel_calls, utils/tfdata.py:215-219).
+    if len(records) > 1 and self._decode_images and any(
+        s.is_encoded_image for s in self._by_name.values()):
+      parsed = list(_decode_pool().map(self.parse_single, records))
+    else:
+      parsed = [self.parse_single(r) for r in records]
     names = set()
     for p in parsed:
       names.update(p)

@@ -1,4 +1,12 @@
-"""Meta-learning: MAML models, preprocessors, task-batched data utilities."""
+"""Meta-learning: MAML models, preprocessors, task-batched data utilities.
+
+Deliberate non-port: the reference's legacy v1 meta models
+(/root/reference/meta_learning/meta_tf_models.py:126,:244 —
+MetaPreprocessor/MetalearningModel over TrainValPair) are deprecated
+within the reference itself in favor of MAMLModel/MAMLPreprocessorV2,
+which is the surface implemented here; nothing in the reference's research
+workloads consumes the v1 API.
+"""
 
 from tensor2robot_tpu.meta_learning.maml_inner_loop import (
     MAMLInnerLoopGradientDescent,

@@ -16,6 +16,7 @@ from tensor2robot_tpu.parallel.sharding import (
     train_state_sharding,
 )
 from tensor2robot_tpu.parallel import collectives
+from tensor2robot_tpu.parallel.flash_attention import flash_attention
 from tensor2robot_tpu.parallel.ring_attention import (
     reference_attention,
     ring_self_attention,

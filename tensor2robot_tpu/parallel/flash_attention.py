@@ -1,0 +1,222 @@
+"""Pallas flash attention: blockwise online-softmax attention in VMEM.
+
+The long-context compute kernel (SURVEY §7 Pallas candidates; the ring
+layer in parallel/ring_attention.py handles the multi-device dimension).
+XLA's attention materializes the full [B, H, L, L] score tensor in HBM —
+O(L^2) memory and two full HBM round-trips over it. This kernel tiles
+q into [block_q, D] VMEM blocks and streams k/v through in [block_k, D]
+blocks, keeping the running (max, sum, accumulator) of the numerically
+stable online softmax (Milakov & Gimelshein 2018; Dao et al. 2022,
+FlashAttention) in VMEM scratch that persists across the innermost grid
+dimension:
+
+  grid = (batch*heads, L/block_q, L/block_k)   # k innermost, sequential
+  s    = q_block @ k_block^T * scale           # MXU, f32 accumulation
+  m'   = max(m, rowmax(s));  p = exp(s - m')   # VPU
+  l    = l * exp(m - m') + rowsum(p)
+  acc  = acc * exp(m - m') + p @ v_block       # MXU
+  at the last k block: out = acc / l
+
+Memory: per-device O(L*D) activations only — no score tensor ever reaches
+HBM. Numerics match the XLA oracle to f32 rounding
+(tests/test_flash_attention.py); measured speed/memory comparison in
+docs/performance.md (1.4-2x over XLA at 8k-16k tokens; runs 32k where XLA
+OOMs). This is the single-device long-context path; ring_attention.py
+handles the cross-device dimension with its own shard-level blockwise
+accumulation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, scale: float, causal: bool, block_q: int,
+                  block_k: int):
+  """One (q-block, k-block) step; accumulators persist across the k grid."""
+  i_k = pl.program_id(2)
+  n_k = pl.num_programs(2)
+
+  @pl.when(i_k == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  q = q_ref[0].astype(jnp.float32)                       # [bq, D]
+  k = k_ref[0].astype(jnp.float32)                       # [bk, D]
+  v = v_ref[0].astype(jnp.float32)
+  s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32) * scale
+  if causal:
+    i_q = pl.program_id(1)
+    q_pos = i_q * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = i_k * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+  m_prev = m_ref[:]                                      # [bq, 1]
+  l_prev = l_ref[:]
+  m_block = jnp.max(s, axis=-1, keepdims=True)           # [bq, 1]
+  m_new = jnp.maximum(m_prev, m_block)
+  safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+  p = jnp.exp(s - safe_m)
+  p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+  correction = jnp.exp(m_prev - safe_m)
+  correction = jnp.where(m_prev <= NEG_INF / 2, 0.0, correction)
+  l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+  m_ref[:] = m_new
+  acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+  @pl.when(i_k == n_k - 1)
+  def _finalize():
+    l_final = jnp.maximum(l_ref[:], 1e-20)
+    o_ref[0] = (acc_ref[:] / l_final).astype(o_ref.dtype)
+    # Log-sum-exp per row, saved for the backward pass (FlashAttention).
+    lse_ref[0] = (m_ref[:] + jnp.log(l_final))[:, 0]
+
+
+def _flash_bhld(q, k, v, *, scale: float, causal: bool, block_q: int,
+                block_k: int, interpret: bool):
+  """[BH, L, D] flash attention via pallas_call."""
+  bh, l_q, d = q.shape
+  l_k = k.shape[1]
+  n_q = pl.cdiv(l_q, block_q)
+  n_k = pl.cdiv(l_k, block_k)
+  kernel = functools.partial(
+      _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+      block_k=block_k)
+  return pl.pallas_call(
+      kernel,
+      grid=(bh, n_q, n_k),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct(q.shape, q.dtype),
+          jax.ShapeDtypeStruct((bh, l_q), jnp.float32),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_q, d), jnp.float32),
+          pltpu.VMEM((block_q, 1), jnp.float32),
+          pltpu.VMEM((block_q, 1), jnp.float32),
+      ],
+      interpret=interpret,
+  )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+  """custom_vjp core over [BH, L, D] operands."""
+  out, _ = _flash_bhld(q, k, v, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret)
+  return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+  out, lse = _flash_bhld(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
+  return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, d_out):
+  """Blockwise FlashAttention backward: a scan over k/v blocks.
+
+  Recomputes P per block from the saved log-sum-exp; memory stays
+  O(L * block_k) — the [L, L] score tensor is never materialized. XLA
+  compiles the scan body (it is matmul-dominated, so the MXU sees the
+  same shapes as the forward kernel).
+  """
+  del block_q
+  q, k, v, out, lse = residuals
+  bh, l_q, d = q.shape
+  l_k = k.shape[1]
+  n_k = l_k // block_k
+  qf = q.astype(jnp.float32)
+  do = d_out.astype(jnp.float32)
+  delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)       # [BH, Lq]
+  k_blocks = k.astype(jnp.float32).reshape(bh, n_k, block_k, d)
+  v_blocks = v.astype(jnp.float32).reshape(bh, n_k, block_k, d)
+  q_pos = jnp.arange(l_q)
+
+  def body(dq_acc, inputs):
+    j, k_j, v_j = inputs                                       # [BH, bk, D]
+    s = jnp.einsum('bqd,bkd->bqk', qf, k_j) * scale            # [BH, Lq, bk]
+    if causal:
+      k_pos = j * block_k + jnp.arange(block_k)
+      s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s,
+                    NEG_INF)
+    p = jnp.exp(s - lse[:, :, None])
+    dv_j = jnp.einsum('bqk,bqd->bkd', p, do)
+    dp = jnp.einsum('bqd,bkd->bqk', do, v_j)
+    ds = p * (dp - delta[:, :, None]) * scale
+    dk_j = jnp.einsum('bqk,bqd->bkd', ds, qf)
+    dq_acc = dq_acc + jnp.einsum('bqk,bkd->bqd', ds, k_j)
+    return dq_acc, (dk_j, dv_j)
+
+  dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+      body, jnp.zeros(q.shape, jnp.float32),
+      (jnp.arange(n_k), k_blocks.transpose(1, 0, 2, 3),
+       v_blocks.transpose(1, 0, 2, 3)))
+  dk = dk_blocks.transpose(1, 0, 2, 3).reshape(k.shape)
+  dv = dv_blocks.transpose(1, 0, 2, 3).reshape(v.shape)
+  return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_diff.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+  """Exact attention over [B, L, H, D] inputs, O(L) memory, differentiable.
+
+  Forward runs the Pallas kernel; the backward is the blockwise
+  FlashAttention recomputation (custom VJP) so training never sees an
+  [L, L] tensor either. Sequence lengths must divide the block sizes
+  (pad upstream — robot episode batches are fixed-length by spec).
+  ``interpret=None`` auto-selects the Pallas interpreter off-TPU so tests
+  run on CPU.
+  """
+  if scale is None:
+    scale = 1.0 / float(np.sqrt(q.shape[-1]))
+  if interpret is None:
+    interpret = jax.default_backend() == 'cpu'
+  b, l_q, h, d = q.shape
+  l_k = k.shape[1]
+  block_q = min(block_q, l_q)
+  block_k = min(block_k, l_k)
+  if l_q % block_q or l_k % block_k:
+    raise ValueError(
+        'Sequence lengths ({}, {}) must be multiples of the block sizes '
+        '({}, {}).'.format(l_q, l_k, block_q, block_k))
+
+  def _to_bhld(x):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+  out = _flash_diff(_to_bhld(q), _to_bhld(k), _to_bhld(v), causal, scale,
+                    block_q, block_k, interpret)
+  return out.reshape(b, h, l_q, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,56 @@
+"""dql_grasping helpers: context merging for conv grasping models.
+
+Parity target: /root/reference/research/dql_grasping_lib/tf_modules.py:49-101
+(``tile_to_match_context``, ``add_context``) — the action-broadcast trick
+QT-Opt-style critics use to merge N candidate actions with one state
+embedding. (The env-run loop that lives alongside them in the reference,
+run_env.py:82-239, is rl/run_env.py here; the slim ``argscope`` conv
+defaults are the explicit Flax module defaults of layers/.)
+
+TPU note: these are the building blocks of the CEM action megabatch
+(networks.py docstring): net stays at batch B while the context carries
+B*num_samples rows, so the expensive conv tower never re-runs per action.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def tile_to_match_context(net: jnp.ndarray,
+                          context: jnp.ndarray) -> jnp.ndarray:
+  """Repeats net along a new axis=1 to match context's samples dim (ref :49).
+
+  Args:
+    net: [B, ...].
+    context: [B, num_samples, C].
+  Returns:
+    [B, num_samples, ...] with each batch element of net tiled.
+  """
+  num_samples = context.shape[1]
+  net_expanded = jnp.expand_dims(net, 1)
+  reps = (1, num_samples) + (1,) * (net_expanded.ndim - 2)
+  return jnp.tile(net_expanded, reps)
+
+
+def add_context(net: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+  """Broadcast-adds per-action context onto conv features (ref :74).
+
+  Args:
+    net: [B, H, W, C] state features.
+    context: [B * num_samples, C] action embeddings (num_samples
+      contiguous rows per state).
+  Returns:
+    [B * num_samples, H, W, C].
+  """
+  batch = net.shape[0]
+  h, w, d1 = net.shape[1:]
+  d2 = context.shape[-1]
+  if d1 != d2:
+    raise ValueError('Context depth {} != net depth {}.'.format(d2, d1))
+  context = context.reshape(batch, -1, d2)           # [B, S, C]
+  num_samples = context.shape[1]
+  net = tile_to_match_context(net, context)          # [B, S, H, W, C]
+  context = context[:, :, None, None, :]             # [B, S, 1, 1, C]
+  out = net + context
+  return out.reshape(batch * num_samples, h, w, d1)

@@ -180,7 +180,12 @@ class VRGripperRegressionNet(nn.Module):
           self.action_size,
           np.asarray(self.output_mean, np.float32)
           if self.output_mean is not None else None)
-      action = mdn.gaussian_mixture_approximate_mode(gm)
+      if self.output_mixture_sample and self.has_rng('dropout'):
+        # Stochastic action output (ref :260-262); deterministic mode when
+        # no rng stream is available (serving without sampling).
+        action = mdn.mixture_sample(gm, self.make_rng('dropout'))
+      else:
+        action = mdn.gaussian_mixture_approximate_mode(gm)
       outputs['dist_params'] = dist_params
     else:
       action = meta_data.multi_batch_apply(

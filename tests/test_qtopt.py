@@ -141,7 +141,7 @@ class TestPackFeatures:
 
 class TestPreprocessor:
 
-  def test_train_crops_distorts_eval_center_crops(self):
+  def test_train_crops_eval_center_crops(self):
     model = _make_model()
     preprocessor = model.preprocessor
     in_spec = preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
@@ -164,6 +164,32 @@ class TestPreprocessor:
     center = np.asarray(features['state/image'])[:, 20:492, 84:556, :] / 255.0
     np.testing.assert_allclose(np.asarray(out_eval['state/image']), center,
                                atol=1e-6)
+
+  def test_distortions_off_by_default_configurable_on(self):
+    """Distortion defaults match the reference's all-off defaults
+    (ref image_transformations.py:182-195); configuring them changes
+    pixels beyond the pure crop."""
+    from tensor2robot_tpu.research.qtopt.t2r_models import (
+        DefaultGrasping44ImagePreprocessor,
+    )
+    from tensor2robot_tpu.specs import generators as spec_generators
+    model = _make_model()
+    plain = model.preprocessor
+    distorting = DefaultGrasping44ImagePreprocessor(
+        model.get_feature_specification, model.get_label_specification,
+        distortion_kwargs={'random_brightness': True,
+                           'random_noise_level': 0.05})
+    in_spec = plain.get_in_feature_specification(ModeKeys.TRAIN)
+    features = spec_generators.make_random_numpy(in_spec, batch_size=2)
+    labels = spec_generators.make_random_numpy(
+        plain.get_in_label_specification(ModeKeys.TRAIN), batch_size=2)
+    rng = jax.random.PRNGKey(0)
+    out_plain, _ = plain.preprocess(features, labels, ModeKeys.TRAIN,
+                                    rng=rng)
+    out_distorted, _ = distorting.preprocess(features, labels,
+                                             ModeKeys.TRAIN, rng=rng)
+    assert not np.allclose(np.asarray(out_plain['state/image']),
+                           np.asarray(out_distorted['state/image']))
 
 
 class TestEndToEnd:

@@ -157,3 +157,27 @@ class TestImage:
     encoded = image_lib.numpy_to_image_string(array, 'png')
     decoded = image_lib.image_string_to_numpy(encoded)
     np.testing.assert_array_equal(decoded, array)
+
+
+class TestDqlGraspingHelpers:
+  """ref research/dql_grasping_lib/tf_modules.py:49-101."""
+
+  def test_tile_to_match_context(self):
+    import numpy as np
+    from tensor2robot_tpu.research.dql_grasping import tile_to_match_context
+    net = np.arange(2 * 3).reshape(2, 3).astype(np.float32)
+    context = np.zeros((2, 4, 5), np.float32)
+    tiled = np.asarray(tile_to_match_context(net, context))
+    assert tiled.shape == (2, 4, 3)
+    np.testing.assert_array_equal(tiled[:, 0], net)
+    np.testing.assert_array_equal(tiled[:, 3], net)
+
+  def test_add_context_broadcasts_actions(self):
+    import numpy as np
+    from tensor2robot_tpu.research.dql_grasping import add_context
+    net = np.ones((2, 4, 4, 8), np.float32)
+    context = np.arange(2 * 3 * 8).reshape(6, 8).astype(np.float32)
+    out = np.asarray(add_context(net, context))
+    assert out.shape == (6, 4, 4, 8)
+    np.testing.assert_allclose(out[0, 0, 0], 1.0 + context[0])
+    np.testing.assert_allclose(out[5, 2, 1], 1.0 + context[5])
