@@ -98,6 +98,66 @@ def _bench_host_pipeline(model, batch_size: int, max_examples: int = 512):
   return seen / dt
 
 
+def _bench_maml_inner_step(mesh) -> float:
+  """BASELINE.md metric #3: MAML train-step latency (pose_env MAML).
+
+  One meta train step = vmapped inner adaptation (fwd+bwd per task) +
+  outer fwd/bwd + optimizer — 8 tasks x (1 condition + 1 inference).
+  """
+  import jax
+  from jax.sharding import NamedSharding, PartitionSpec as P
+
+  from tensor2robot_tpu.meta_learning.maml_inner_loop import (
+      MAMLInnerLoopGradientDescent,
+  )
+  from tensor2robot_tpu.meta_learning.meta_data import (
+      MAMLRandomInputGenerator,
+  )
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.parallel import sharding as sharding_lib
+  from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+      PoseEnvRegressionModelMAML,
+  )
+  from tensor2robot_tpu.research.pose_env.pose_env_models import (
+      PoseEnvRegressionModel,
+  )
+  from tensor2robot_tpu.trainer import Trainer
+
+  maml = PoseEnvRegressionModelMAML(
+      base_model=PoseEnvRegressionModel(),
+      inner_loop=MAMLInnerLoopGradientDescent(learning_rate=0.01))
+  # Task batch must split over the mesh data axis on any slice size.
+  data_axis = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+  num_tasks = max(8, data_axis)
+  generator = MAMLRandomInputGenerator(
+      num_tasks=num_tasks, num_condition_samples_per_task=1,
+      num_inference_samples_per_task=1)
+  generator.set_specification_from_model(maml, ModeKeys.TRAIN)
+  features, labels = next(
+      generator.create_dataset_iterator(mode=ModeKeys.TRAIN, seed=0))
+  with tempfile.TemporaryDirectory() as tmp:
+    trainer = Trainer(maml, tmp, mesh=mesh, async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=10**9)
+    try:
+      state = trainer.init_state(features, labels)
+      step_fn = trainer._compile_train_step()
+      rng = jax.device_put(jax.random.PRNGKey(2), NamedSharding(mesh, P()))
+      batch = sharding_lib.shard_batch(
+          {'features': features.to_dict(), 'labels': labels.to_dict()},
+          mesh)
+      state, _ = step_fn(state, batch['features'], batch['labels'], rng)
+      jax.block_until_ready(state.params)
+      n_steps = 20
+      t0 = time.time()
+      for _ in range(n_steps):
+        state, _ = step_fn(state, batch['features'], batch['labels'], rng)
+      jax.block_until_ready(state.params)
+      dt = (time.time() - t0) / n_steps
+    finally:
+      trainer.close()
+  return dt * 1000.0
+
+
 def main():
   import jax
 
@@ -185,6 +245,10 @@ def main():
 
   host_rate = _bench_host_pipeline(model, batch_size=min(batch_size, 64),
                                    max_examples=256)
+  try:
+    maml_step_ms = _bench_maml_inner_step(mesh)
+  except Exception:  # noqa: BLE001 — never lose the headline metric
+    maml_step_ms = -1.0
 
   print(json.dumps({
       'metric': 'qtopt_train_samples_per_sec_per_chip',
@@ -198,6 +262,7 @@ def main():
       'n_chips': n_chips,
       'host_examples_per_sec': round(host_rate, 2),
       'host_vs_device': round(host_rate / max(examples_per_sec, 1e-9), 4),
+      'maml_train_step_ms': round(maml_step_ms, 3),
   }))
 
 

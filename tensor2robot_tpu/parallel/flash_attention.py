@@ -40,30 +40,28 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                  l_ref, *, scale: float, causal: bool, block_q: int,
-                  block_k: int):
-  """One (q-block, k-block) step; accumulators persist across the k grid."""
+def _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  q_offset, k_offset):
+  """The shared online-softmax block update both kernels run.
+
+  Reads one q/k/v block from refs, scores it, and folds it into the
+  (acc, m, l) scratch accumulators. ``q_offset``/``k_offset`` are the
+  GLOBAL positions of the blocks' first rows (plain ints or traced
+  scalars) for causal masking.
+  """
+  i_q = pl.program_id(1)
   i_k = pl.program_id(2)
-  n_k = pl.num_programs(2)
-
-  @pl.when(i_k == 0)
-  def _init():
-    acc_ref[:] = jnp.zeros_like(acc_ref)
-    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-    l_ref[:] = jnp.zeros_like(l_ref)
-
   q = q_ref[0].astype(jnp.float32)                       # [bq, D]
   k = k_ref[0].astype(jnp.float32)                       # [bk, D]
   v = v_ref[0].astype(jnp.float32)
   s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                           preferred_element_type=jnp.float32) * scale
   if causal:
-    i_q = pl.program_id(1)
-    q_pos = i_q * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = i_k * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    q_pos = (q_offset + i_q * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0))
+    k_pos = (k_offset + i_k * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1))
     s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
   m_prev = m_ref[:]                                      # [bq, 1]
@@ -79,6 +77,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
   m_ref[:] = m_new
   acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
       p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                  l_ref, *, scale: float, causal: bool, block_q: int,
+                  block_k: int):
+  """One (q-block, k-block) step; accumulators persist across the k grid."""
+  i_k = pl.program_id(2)
+  n_k = pl.num_programs(2)
+
+  @pl.when(i_k == 0)
+  def _init():
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+
+  _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                q_offset=0, k_offset=0)
 
   @pl.when(i_k == n_k - 1)
   def _finalize():
@@ -121,6 +137,101 @@ def _flash_bhld(q, k, v, *, scale: float, causal: bool, block_q: int,
       ],
       interpret=interpret,
   )(q, k, v)
+
+
+def _flash_carry_kernel(offsets_ref, q_ref, k_ref, v_ref, o_in_ref,
+                        m_in_ref, l_in_ref, o_out_ref, m_out_ref,
+                        l_out_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                        causal: bool, block_q: int, block_k: int):
+  """Flash block update with EXTERNAL accumulators (for ring attention).
+
+  Like _flash_kernel but the online-softmax state (o, m, l) is carried in
+  and out UNNORMALIZED — the ring loop feeds each hop's outputs into the
+  next and normalizes once at the end. ``offsets_ref`` (scalar prefetch)
+  holds the global (q_offset, k_offset) so causal masking sees global
+  positions even though each device only holds its shard.
+  """
+  i_k = pl.program_id(2)
+  n_k = pl.num_programs(2)
+
+  @pl.when(i_k == 0)
+  def _init():
+    acc_ref[:] = o_in_ref[0].astype(jnp.float32)
+    m_ref[:] = m_in_ref[0].astype(jnp.float32)[:, None]
+    l_ref[:] = l_in_ref[0].astype(jnp.float32)[:, None]
+
+  _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                q_offset=offsets_ref[0], k_offset=offsets_ref[1])
+
+  @pl.when(i_k == n_k - 1)
+  def _finalize():
+    o_out_ref[0] = acc_ref[:]
+    m_out_ref[0] = m_ref[:][:, 0]
+    l_out_ref[0] = l_ref[:][:, 0]
+
+
+def flash_attention_carry(q, k, v, o, m, l, q_offset, k_offset,
+                          causal: bool, scale: float,
+                          block_q: int = 128, block_k: int = 128,
+                          interpret: Optional[bool] = None):
+  """One unnormalized flash update of (o, m, l) with a new k/v block.
+
+  Shapes: q [BH, Lq, D]; k/v [BH, Lk, D]; o [BH, Lq, D] f32; m/l [BH, Lq]
+  f32. ``q_offset``/``k_offset`` are traced global-position scalars.
+  Returns updated (o, m, l). This is the ring-attention inner kernel;
+  forward-only (no VJP) — the differentiable ring path is the jnp one.
+  """
+  if interpret is None:
+    interpret = jax.default_backend() == 'cpu'
+  bh, l_q, d = q.shape
+  l_k = k.shape[1]
+  block_q = min(block_q, l_q)
+  block_k = min(block_k, l_k)
+  if l_q % block_q or l_k % block_k:
+    raise ValueError(
+        'Shard lengths ({}, {}) must be multiples of the block sizes '
+        '({}, {}).'.format(l_q, l_k, block_q, block_k))
+  n_q = l_q // block_q
+  n_k = l_k // block_k
+  kernel = functools.partial(
+      _flash_carry_kernel, scale=scale, causal=causal, block_q=block_q,
+      block_k=block_k)
+  offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                       jnp.asarray(k_offset, jnp.int32)])
+  grid_spec = pltpu.PrefetchScalarGridSpec(
+      num_scalar_prefetch=1,
+      grid=(bh, n_q, n_k),
+      # Index maps receive the scalar-prefetch ref as a trailing arg.
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j, off: (b, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j, off: (b, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j, off: (b, j, 0)),
+          pl.BlockSpec((1, block_q, d), lambda b, i, j, off: (b, i, 0)),
+          pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+          pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j, off: (b, i, 0)),
+          pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+          pl.BlockSpec((1, block_q), lambda b, i, j, off: (b, i)),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_q, d), jnp.float32),
+          pltpu.VMEM((block_q, 1), jnp.float32),
+          pltpu.VMEM((block_q, 1), jnp.float32),
+      ],
+  )
+  return pl.pallas_call(
+      kernel,
+      grid_spec=grid_spec,
+      out_shape=[
+          jax.ShapeDtypeStruct(o.shape, jnp.float32),
+          jax.ShapeDtypeStruct(m.shape, jnp.float32),
+          jax.ShapeDtypeStruct(l.shape, jnp.float32),
+      ],
+      interpret=interpret,
+  )(offsets, q, k, v, o, m, l)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

@@ -58,12 +58,17 @@ def _block_attention(q, k, v, o, m, l, q_offset, k_offset, causal,
 
 
 def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
-                          scale: float):
+                          scale: float, use_pallas: bool):
   """Per-shard body: local q attends to every k/v block as it rings past."""
   axis_size = lax.psum(1, axis_name)
   my_index = lax.axis_index(axis_name)
   block_q = q.shape[1]
   block_k = k.shape[1]
+
+  if use_pallas:
+    return _ring_shard_pallas(q, k, v, axis_name, causal, scale,
+                              axis_size, my_index)
+
   o = jnp.zeros(q.shape, jnp.float32)
   m = jnp.full(q.shape[:2] + (q.shape[2],), NEG_INF, jnp.float32)
   l = jnp.zeros(q.shape[:2] + (q.shape[2],), jnp.float32)
@@ -87,9 +92,51 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
   return (o / l[:, :, :, None]).astype(q.dtype)
 
 
+def _ring_shard_pallas(q, k, v, axis_name: str, causal: bool, scale: float,
+                       axis_size, my_index):
+  """Pallas-kernel ring body, entirely in the kernel's [B*H, L, D] layout.
+
+  q and the accumulators are converted ONCE before the loop and back once
+  after; only k/v (which must rotate anyway) ride the ring. Forward-only —
+  ring_self_attention keeps the jnp path for differentiation.
+  """
+  from tensor2robot_tpu.parallel.flash_attention import (
+      flash_attention_carry,
+  )
+  b, block_q, h, d = q.shape
+  block_k = k.shape[1]
+
+  def _to_bhld(x):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+  q_bhld = _to_bhld(q)
+  o = jnp.zeros(q_bhld.shape, jnp.float32)
+  m = jnp.full(q_bhld.shape[:2], NEG_INF, jnp.float32)
+  l = jnp.zeros(q_bhld.shape[:2], jnp.float32)
+
+  def body(i, carry):
+    o, m, l, k_cur, v_cur = carry
+    src = (my_index - i) % axis_size
+    o, m, l = flash_attention_carry(
+        q_bhld, k_cur, v_cur, o, m, l,
+        q_offset=my_index * block_q, k_offset=src * block_k,
+        causal=causal, scale=scale)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    k_next = lax.ppermute(k_cur, axis_name, perm)
+    v_next = lax.ppermute(v_cur, axis_name, perm)
+    return o, m, l, k_next, v_next
+
+  o, m, l, _, _ = lax.fori_loop(
+      0, axis_size, body, (o, m, l, _to_bhld(k), _to_bhld(v)))
+  l = jnp.maximum(l, 1e-20)
+  out = o / l[:, :, None]
+  return out.reshape(b, h, block_q, d).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = 'data',
                         causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        use_pallas: Optional[bool] = None):
   """Exact attention with q/k/v sequence-sharded over ``seq_axis``.
 
   Args:
@@ -98,15 +145,30 @@ def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = 'data',
     seq_axis: mesh axis carrying sequence blocks.
     causal: apply a causal mask over *global* positions.
     scale: score scale; default 1/sqrt(D).
+    use_pallas: run each intra-shard block update through the Pallas
+      flash kernel (parallel/flash_attention.py) — no per-hop [Lq, Lk]
+      score tensor in HBM. FORWARD-ONLY (the carry kernel has no VJP);
+      default False so training code differentiates through the jnp
+      path. Opt in for inference/serving on TPU; requires per-device
+      shard lengths divisible by the kernel block sizes (<=128).
 
   Returns [B, L, H, D], sharded like q.
   """
   if scale is None:
     scale = 1.0 / (q.shape[-1] ** 0.5)
+  if use_pallas is None:
+    use_pallas = False
+  if use_pallas:
+    axis_size = mesh.shape[seq_axis]
+    shard_len = q.shape[1] // axis_size
+    if shard_len % min(128, shard_len) != 0:
+      raise ValueError(
+          'use_pallas requires per-device shard length ({}) divisible by '
+          'the kernel block size.'.format(shard_len))
   spec = P(None, seq_axis, None, None)
   fn = jax.shard_map(
       functools.partial(_ring_attention_shard, axis_name=seq_axis,
-                        causal=causal, scale=scale),
+                        causal=causal, scale=scale, use_pallas=use_pallas),
       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
       check_vma=False)
   return fn(q, k, v)

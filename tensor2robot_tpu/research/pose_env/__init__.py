@@ -4,6 +4,9 @@ from tensor2robot_tpu.research.pose_env.pose_env import (
     PoseEnvRandomPolicy,
     PoseToyEnv,
 )
+from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+    PoseEnvRegressionModelMAML,
+)
 from tensor2robot_tpu.research.pose_env.pose_env_models import (
     DefaultPoseEnvContinuousPreprocessor,
     DefaultPoseEnvRegressionPreprocessor,
@@ -15,6 +18,7 @@ from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
 )
 
 __all__ = [
+    'PoseEnvRegressionModelMAML',
     'DefaultPoseEnvContinuousPreprocessor',
     'DefaultPoseEnvRegressionPreprocessor',
     'PoseEnvContinuousMCModel',

@@ -219,3 +219,60 @@ class TestEndToEnd:
     action = policy.SelectAction(obs, None, 0)
     assert np.asarray(action).shape == (CEM_ACTION_SIZE,)
     predictor.close()
+
+
+class TestLearningDynamics:
+
+  def test_critic_learns_action_conditional_rule(self, tmp_path):
+    """Loss drops on a learnable synthetic rule: success == close_gripper.
+
+    Stronger than the 2-step smoke test: proves gradients reach the
+    grasp-param pathway through the legacy optimizer stack.
+    """
+    from tensor2robot_tpu.data.input_generators import (
+        GeneratorInputGenerator,
+    )
+
+    rng = np.random.RandomState(0)
+
+    def batch_fn(batch_size):
+      features = {
+          'state/image': rng.randint(0, 255, (batch_size, 512, 640, 3),
+                                     dtype=np.uint8).astype(np.uint8),
+      }
+      close = (rng.rand(batch_size, 1) > 0.5).astype(np.float32)
+      for key, size in (('world_vector', 3), ('vertical_rotation', 2),
+                        ('open_gripper', 1), ('terminate_episode', 1),
+                        ('gripper_closed', 1), ('height_to_bottom', 1)):
+        features['action/' + key] = rng.rand(batch_size, size).astype(
+            np.float32)
+      features['action/close_gripper'] = close
+      labels = {'reward': close.copy()}
+      return features, labels
+
+    model = _make_model(use_avg_model_params=False,
+                        learning_rate=3e-3)
+    generator = GeneratorInputGenerator(batch_generator_fn=batch_fn,
+                                        batch_size=8)
+    losses = []
+
+    class _Recorder:
+      def begin(self, trainer):
+        pass
+
+      def after_step(self, trainer, state, step, metrics):
+        if metrics is not None and 'loss' in metrics:
+          losses.append(float(np.asarray(metrics['loss'])))
+
+      def end(self, trainer, state):
+        pass
+
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=1)
+    trainer.train(generator, max_train_steps=12, hooks=[_Recorder()])
+    trainer.close()
+    # Momentum SGD at this LR learns the rule steadily (~0.69 -> ~0.58
+    # over 12 steps on this seed); assert a clear monotone-ish decrease.
+    early = np.mean(losses[:3])
+    late = np.mean(losses[-3:])
+    assert late < 0.92 * early, (early, late, losses)
