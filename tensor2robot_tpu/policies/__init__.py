@@ -2,6 +2,7 @@
 
 from tensor2robot_tpu.policies.policies import (
     CEMPolicy,
+    DeviceCEMPolicy,
     LSTMCEMPolicy,
     OUExploreRegressionPolicy,
     PerEpisodeSwitchPolicy,
@@ -13,6 +14,7 @@ from tensor2robot_tpu.policies.policies import (
 
 __all__ = [
     'CEMPolicy',
+    'DeviceCEMPolicy',
     'LSTMCEMPolicy',
     'OUExploreRegressionPolicy',
     'PerEpisodeSwitchPolicy',

@@ -18,6 +18,7 @@ from __future__ import annotations
 import abc
 from typing import Any, Callable, Optional
 
+import jax
 import numpy as np
 
 from tensor2robot_tpu.utils import cross_entropy
@@ -141,6 +142,36 @@ class CEMPolicy(Policy):
     del explore_prob
     action, debug = self._select_action_with_debug(obs, None, None)
     return action, {'q': debug['q_predicted']}
+
+
+class DeviceCEMPolicy(Policy):
+  """CEM argmax with the WHOLE optimize loop on device (one dispatch).
+
+  TPU-native upgrade over CEMPolicy's numpy loop (3 predictor round trips
+  per action, ref :139-172): the model provides a traceable selector via
+  ``make_on_device_select_action`` and every robot action is a single
+  jitted call over the predictor's restored variables.
+  """
+
+  def __init__(self,
+               t2r_model,
+               cem_iters: int = 3,
+               cem_samples: int = 64,
+               num_elites: int = 10,
+               seed: int = 0,
+               **parent_kwargs):
+    super().__init__(**parent_kwargs)
+    self._t2r_model = t2r_model
+    self._rng = jax.random.PRNGKey(seed)
+    self._select = jax.jit(t2r_model.make_on_device_select_action(
+        cem_samples=cem_samples, cem_iters=cem_iters,
+        num_elites=num_elites))
+
+  def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
+    del context, timestep
+    self._rng, step_rng = jax.random.split(self._rng)
+    action = self._select(self._predictor.variables, dict(state), step_rng)
+    return np.asarray(jax.device_get(action))
 
 
 class LSTMCEMPolicy(CEMPolicy):

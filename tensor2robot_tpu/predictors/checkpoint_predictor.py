@@ -89,6 +89,12 @@ class CheckpointPredictor(AbstractPredictor):
 
   # -- serving ---------------------------------------------------------------
 
+  @property
+  def variables(self):
+    """The restored variables pytree (for custom jitted serving paths)."""
+    self.assert_is_loaded()
+    return self._variables
+
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     self.assert_is_loaded()
     outputs = self._serve_fn(self._variables, dict(features))

@@ -277,6 +277,11 @@ class LegacyGraspingModelWrapper(CriticModel):
     return abstract_model.AbstractT2RModel.predict_step(self, state, features)
 
 
+def _tile_scalar(value, num_samples: int):
+  return jnp.broadcast_to(jnp.asarray(value, jnp.float32).reshape(1, 1),
+                          (num_samples, 1))
+
+
 class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
     LegacyGraspingModelWrapper):
   """The QT-Opt flagship critic (ref :316-404).
@@ -310,3 +315,64 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
   def pack_features(self, *policy_inputs):
     """ref :399-400."""
     return pack_features_kuka_e2e(self, *policy_inputs)
+
+  def make_on_device_select_action(self,
+                                   cem_samples: int = 64,
+                                   cem_iters: int = 3,
+                                   num_elites: int = 10):
+    """Builds the one-dispatch CEM action selector (DeviceCEMPolicy).
+
+    The reference's CEM loop round-trips host<->device per iteration
+    (policies.py:139-172: numpy CEM calling session.run 3x); here the
+    ENTIRE loop — preprocessing, the lax.scan of CEM iterations, each
+    scoring 64 candidates through the megabatch critic — is one jitted
+    XLA program, so a robot action costs one dispatch and one image
+    upload.
+
+    Returns ``select(variables, state_dict, rng) -> action [8]`` with
+    ``state_dict`` = {'image' uint8 [512, 640, 3], 'gripper_closed',
+    'height_to_bottom'}.
+    """
+    from tensor2robot_tpu.utils import cross_entropy
+
+    def select(variables, state, rng):
+      # Same serving semantics as every other path: EMA-averaged params
+      # when configured (TrainState.variables), and the model's OWN
+      # preprocessor for the predict-mode image transform.
+      variables = dict(variables)
+      avg_params = variables.pop('avg_params', None)
+      if self.use_avg_model_params and avg_params is not None:
+        variables['params'] = avg_params
+      placeholder = SpecStruct()
+      placeholder['state/image'] = jnp.asarray(state['image'])[None]
+      offset = 0
+      for key, size in ACTION_DIM_LAYOUT:
+        placeholder['action/' + key] = jnp.zeros((1, size), jnp.float32)
+        offset += size
+      for key in ('gripper_closed', 'height_to_bottom'):
+        placeholder['action/' + key] = _tile_scalar(state[key], 1)
+      processed, _ = self.preprocessor.preprocess(
+          placeholder, None, ModeKeys.PREDICT, rng=None)
+      image = processed['state/image']
+
+      def objective(samples):
+        features = SpecStruct()
+        features['state/image'] = image
+        offset = 0
+        for key, size in ACTION_DIM_LAYOUT:
+          features['action/' + key] = samples[:, offset:offset + size]
+          offset += size
+        for key in ('gripper_closed', 'height_to_bottom'):
+          features['action/' + key] = _tile_scalar(state[key], cem_samples)
+        outputs, _ = self.inference_network_fn(
+            variables, features, None, ModeKeys.PREDICT, None)
+        return outputs['q_predicted']
+
+      _, _, best = cross_entropy.jax_normal_cem(
+          objective, jnp.zeros((CEM_ACTION_SIZE,), jnp.float32),
+          jnp.ones((CEM_ACTION_SIZE,), jnp.float32), rng,
+          num_samples=cem_samples, num_elites=num_elites,
+          num_iterations=cem_iters)
+      return best
+
+    return select

@@ -471,6 +471,10 @@ def train_eval_model(t2r_model: AbstractT2RModel,
     if not isinstance(preprocessor, Bfloat16PreprocessorWrapper):
       t2r_model.set_preprocessor(Bfloat16PreprocessorWrapper(preprocessor))
 
+  if eval_name is None and input_generator_eval is not None:
+    # Multi-eval jobs route their events to eval_<name> dirs keyed by
+    # TF_CONFIG.multi_eval_name (ref utils/train_eval.py:522-547).
+    eval_name = getattr(input_generator_eval, 'multi_eval_name', None)
   trainer = Trainer(
       t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp, seed=seed,
       keep_checkpoint_max=keep_checkpoint_max,

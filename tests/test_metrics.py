@@ -104,3 +104,38 @@ class TestTrainerIntegration:
       assert 'snapshot_probe.param = 1' in snapshot
     finally:
       ginlike.clear_config()
+
+
+class TestMultiEvalRouting:
+
+  def test_multi_eval_name_routes_events(self, tmp_path, monkeypatch):
+    """TF_CONFIG.multi_eval_name names the eval events dir (ref :522-547)."""
+    import json
+
+    from tensor2robot_tpu.data.input_generators import (
+        MultiEvalRecordInputGenerator,
+    )
+    from tensor2robot_tpu.data.tfrecord import write_records
+    from tensor2robot_tpu.data import wire
+
+    # One tiny record file serving as the 'holdout' eval dataset.
+    record_path = str(tmp_path / 'eval.tfrecord')
+    from tensor2robot_tpu.utils.mocks import MOCK_STATE_DIM
+    write_records(record_path, [
+        wire.build_example({
+            'measured_position': np.full((MOCK_STATE_DIM,), 0.5, np.float32),
+            'valid_position': np.asarray([1.0], np.float32)})
+        for _ in range(16)
+    ])
+    monkeypatch.setenv('TF_CONFIG',
+                       json.dumps({'multi_eval_name': 'holdout'}))
+    model = MockT2RModel(use_batch_norm=False, device_type='cpu')
+    train_gen = MockInputGenerator(batch_size=16)
+    eval_gen = MultiEvalRecordInputGenerator(
+        eval_map={'holdout': record_path}, batch_size=8)
+    train_eval_model(model, str(tmp_path / 'run'),
+                     input_generator_train=train_gen,
+                     input_generator_eval=eval_gen,
+                     max_train_steps=2, eval_steps=1,
+                     eval_throttle_steps=2, async_checkpoints=False)
+    assert read_events(str(tmp_path / 'run' / 'eval_holdout'))
