@@ -157,8 +157,14 @@ def register_all() -> None:
 
   # Pose env workload.
   register(pose_env.PoseToyEnv, 'PoseToyEnv')
+  register(pose_env.PoseEnvRandomPolicy, 'PoseEnvRandomPolicy')
   register(pose_env_models.PoseEnvRegressionModel, 'PoseEnvRegressionModel')
   register(pose_env_models.PoseEnvContinuousMCModel,
            'PoseEnvContinuousMCModel')
   register(pose_env_maml_models.PoseEnvRegressionModelMAML,
            'PoseEnvRegressionModelMAML')
+  from tensor2robot_tpu.data import writer as replay_writer_module
+  from tensor2robot_tpu.research.pose_env import episode_to_transitions
+  register(replay_writer_module.TFRecordReplayWriter, 'TFRecordReplayWriter')
+  register(episode_to_transitions.episode_to_transitions_pose_toy,
+           'episode_to_transitions_pose_toy')

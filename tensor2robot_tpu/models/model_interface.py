@@ -78,6 +78,17 @@ class ModelInterface(abc.ABC):
     del features, mode
     return inference_outputs
 
+  def add_summaries(self, features, labels, inference_outputs,
+                    mode: str) -> Optional[dict]:
+    """Optional rich summaries (ref abstract_model.py:556 add_summaries).
+
+    Called on HOST numpy data for one batch per eval; return
+    {'images': {tag: [N, H, W, C]}, 'histograms': {tag: values},
+    'scalars': {tag: value}} (any subset) for the metrics writer, or None.
+    """
+    del features, labels, inference_outputs, mode
+    return None
+
   # -- device / precision ---------------------------------------------------
 
   @property

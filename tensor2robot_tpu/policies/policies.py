@@ -170,8 +170,17 @@ class DeviceCEMPolicy(Policy):
   def SelectAction(self, state, context, timestep):  # pylint: disable=invalid-name
     del context, timestep
     self._rng, step_rng = jax.random.split(self._rng)
-    action = self._select(self._predictor.variables, dict(state), step_rng)
+    action, _ = self._select(self._predictor.variables, dict(state),
+                             step_rng)
     return np.asarray(jax.device_get(action))
+
+  def sample_action(self, obs, explore_prob):
+    """run_env adapter surfacing the elite Q (run_env.py reads debug['q'])."""
+    del explore_prob
+    self._rng, step_rng = jax.random.split(self._rng)
+    action, q = self._select(self._predictor.variables, dict(obs), step_rng)
+    action, q = jax.device_get((action, q))
+    return np.asarray(action), {'q': float(q)}
 
 
 class LSTMCEMPolicy(CEMPolicy):

@@ -217,3 +217,14 @@ class Grasp2VecModel(AbstractT2RModel):
     for key in train_outputs:
       metrics[key] = train_outputs[key]
     return metrics
+
+  def add_summaries(self, features, labels, inference_outputs, mode: str):
+    """Heatmaps, keypoints, and distance histograms (ref :224-245)."""
+    del labels, mode
+    from tensor2robot_tpu.research.grasp2vec import visualization
+
+    raw = visualization.grasp2vec_summaries(features, inference_outputs)
+    images = {k: v for k, v in raw.items() if not k.startswith('hist/')}
+    histograms = {k[len('hist/'):]: v for k, v in raw.items()
+                  if k.startswith('hist/')}
+    return {'images': images, 'histograms': histograms}

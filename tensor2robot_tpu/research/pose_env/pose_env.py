@@ -28,6 +28,13 @@ class PoseEnvRandomPolicy:
   def reset(self):
     pass
 
+  def restore(self) -> bool:
+    """Nothing to restore (collect_eval_loop polling protocol)."""
+    return True
+
+  def init_randomly(self) -> None:
+    pass
+
   @property
   def global_step(self) -> int:
     return 0

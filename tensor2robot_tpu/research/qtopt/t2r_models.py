@@ -329,9 +329,9 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
     XLA program, so a robot action costs one dispatch and one image
     upload.
 
-    Returns ``select(variables, state_dict, rng) -> action [8]`` with
-    ``state_dict`` = {'image' uint8 [512, 640, 3], 'gripper_closed',
-    'height_to_bottom'}.
+    Returns ``select(variables, state_dict, rng) -> (action [8], q)``
+    with ``state_dict`` = {'image' uint8 [512, 640, 3], 'gripper_closed',
+    'height_to_bottom'} and ``q`` the selected action's Q-value.
     """
     from tensor2robot_tpu.utils import cross_entropy
 
@@ -345,10 +345,8 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
         variables['params'] = avg_params
       placeholder = SpecStruct()
       placeholder['state/image'] = jnp.asarray(state['image'])[None]
-      offset = 0
       for key, size in ACTION_DIM_LAYOUT:
         placeholder['action/' + key] = jnp.zeros((1, size), jnp.float32)
-        offset += size
       for key in ('gripper_closed', 'height_to_bottom'):
         placeholder['action/' + key] = _tile_scalar(state[key], 1)
       processed, _ = self.preprocessor.preprocess(
@@ -363,7 +361,8 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
           features['action/' + key] = samples[:, offset:offset + size]
           offset += size
         for key in ('gripper_closed', 'height_to_bottom'):
-          features['action/' + key] = _tile_scalar(state[key], cem_samples)
+          features['action/' + key] = _tile_scalar(state[key],
+                                                   samples.shape[0])
         outputs, _ = self.inference_network_fn(
             variables, features, None, ModeKeys.PREDICT, None)
         return outputs['q_predicted']
@@ -373,6 +372,7 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
           jnp.ones((CEM_ACTION_SIZE,), jnp.float32), rng,
           num_samples=cem_samples, num_elites=num_elites,
           num_iterations=cem_iters)
-      return best
+      # The elite Q for per-step monitoring (run_env reads debug['q']).
+      return best, objective(best[None])[0]
 
     return select

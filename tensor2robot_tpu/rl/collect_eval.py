@@ -42,7 +42,9 @@ def collect_eval_loop(collect_env,
     num_collect: collect episodes per policy version.
     num_eval: eval episodes per policy version.
     run_agent_fn: override for run_env.run_env.
-    root_dir: base dir; data lands in policy_collect/ and eval/.
+    root_dir: base dir; run_env writes policy_collect/ and policy_eval/
+      under it (the reference passes root_dir straight through,
+      ref continuous_collect_eval.py:100-107).
     continuous: keep polling for newer policies until step > max_steps.
     min_collect_eval_step: skip policy versions below this step.
     max_steps: stop once the policy's step exceeds this (continuous mode).
@@ -61,15 +63,12 @@ def collect_eval_loop(collect_env,
     # open across versions and close them once on exit.
     run_agent_fn = functools.partial(run_env_lib.run_env, close_env=False)
 
-  collect_dir = os.path.join(root_dir, 'policy_collect')
-  eval_dir = os.path.join(root_dir, 'eval')
-
   try:
     _collect_eval(collect_env, eval_env, policy_class, num_collect, num_eval,
                   run_agent_fn, root_dir, continuous, min_collect_eval_step,
                   max_steps, record_eval_env_video,
                   init_with_random_variables, poll_sleep_secs,
-                  max_poll_attempts, collect_dir, eval_dir)
+                  max_poll_attempts)
   finally:
     if owns_envs:
       for env in (collect_env, eval_env):
@@ -81,7 +80,7 @@ def _collect_eval(collect_env, eval_env, policy_class, num_collect, num_eval,
                   run_agent_fn, root_dir, continuous, min_collect_eval_step,
                   max_steps, record_eval_env_video,
                   init_with_random_variables, poll_sleep_secs,
-                  max_poll_attempts, collect_dir, eval_dir) -> None:
+                  max_poll_attempts) -> None:
   policy = policy_class()
   prev_global_step = -1
   attempts = 0
@@ -107,14 +106,14 @@ def _collect_eval(collect_env, eval_env, policy_class, num_collect, num_eval,
 
     if collect_env:
       run_agent_fn(collect_env, policy=policy, num_episodes=num_collect,
-                   root_dir=collect_dir, global_step=global_step,
+                   root_dir=root_dir, global_step=global_step,
                    tag='collect')
     if eval_env:
       if record_eval_env_video and hasattr(eval_env, 'set_video_output_dir'):
         eval_env.set_video_output_dir(
             os.path.join(root_dir, 'videos', str(global_step)))
       run_agent_fn(eval_env, policy=policy, num_episodes=num_eval,
-                   root_dir=eval_dir, global_step=global_step, tag='eval')
+                   root_dir=root_dir, global_step=global_step, tag='eval')
     if not continuous or global_step >= max_steps:
       return
 

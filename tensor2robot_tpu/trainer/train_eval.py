@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
 from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, TrainState
+from tensor2robot_tpu.models.model_interface import ModelInterface
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import sharding as sharding_lib
@@ -356,6 +357,7 @@ class Trainer:
     eval_fn = self._compile_eval_step()
     totals: Dict[str, float] = {}
     count = 0
+    last_batch = None
     for _ in range(eval_steps):
       if batch is None:
         try:
@@ -373,12 +375,72 @@ class Trainer:
       for key, value in metrics.items():
         totals[key] = totals.get(key, 0.0) + float(np.mean(value))
       count += 1
+      last_batch = (features, labels)
     averaged = {k: v / max(count, 1) for k, v in totals.items()}
     writer = self.eval_metrics_writer
     if writer is not None:
-      writer.write_scalars(int(jax.device_get(state.step)), averaged)
+      step = int(jax.device_get(state.step))
+      writer.write_scalars(step, averaged)
+      self._write_model_summaries(writer, state, last_batch, step)
       writer.flush()
     return averaged
+
+  def _compile_summary_step(self):
+    """Jitted (preprocess + forward) for add_summaries, like eval/predict."""
+    if getattr(self, '_summary_step_fn', None) is not None:
+      return self._summary_step_fn
+    model = self.model
+    use_avg = self.use_avg_params_for_eval
+
+    def step(state, features, labels):
+      features, labels = model.preprocessor.preprocess(
+          SpecStruct(**features),
+          SpecStruct(**labels) if labels is not None else None,
+          ModeKeys.EVAL, rng=None)
+      variables = state.variables(use_avg_params=use_avg)
+      outputs, _ = model.inference_network_fn(
+          variables, features, labels, ModeKeys.EVAL, None)
+      return dict(features), (dict(labels) if labels is not None else None), \
+          dict(outputs)
+
+    batch = self._batch_sharding()
+    self._summary_step_fn = jax.jit(
+        step, in_shardings=(self._state_sharding, batch, batch))
+    return self._summary_step_fn
+
+  def _write_model_summaries(self, writer, state, batch, step: int) -> None:
+    """Model-provided rich summaries for one eval batch (ref add_summaries).
+
+    Runs one jitted forward pass on the last eval batch and hands host
+    arrays to ``model.add_summaries``; whatever comes back lands in the
+    eval events.
+    """
+    if batch is None or self.model.add_summaries.__func__ is \
+        ModelInterface.add_summaries:
+      return  # default no-op implementation: skip the extra forward pass
+    try:
+      raw_features, raw_labels = batch
+      device_batch = sharding_lib.shard_batch(
+          {'features': raw_features.to_dict(),
+           'labels': raw_labels.to_dict() if raw_labels is not None
+           else None}, self.mesh)
+      features, labels, outputs = self._compile_summary_step()(
+          state, device_batch['features'], device_batch['labels'])
+      host = jax.device_get
+      summaries = self.model.add_summaries(
+          host(features),
+          host(labels) if labels is not None else None,
+          host(outputs), ModeKeys.EVAL)
+      if not summaries:
+        return
+      if summaries.get('scalars'):
+        writer.write_scalars(step, summaries['scalars'])
+      if summaries.get('images'):
+        writer.write_images(step, summaries['images'])
+      if summaries.get('histograms'):
+        writer.write_histograms(step, summaries['histograms'])
+    except Exception as e:  # noqa: BLE001 — summaries never fail an eval
+      _log('add_summaries failed: %s', e)
 
   def predict(self, state: TrainState, features: SpecStruct
               ) -> Dict[str, np.ndarray]:

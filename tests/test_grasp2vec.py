@@ -230,3 +230,27 @@ class TestGrasp2VecModel:
     out_eval, _ = pre.preprocess(features, None, ModeKeys.EVAL, rng=None)
     np.testing.assert_array_equal(np.asarray(out_eval['pregrasp_image']),
                                   np.asarray(out_eval['postgrasp_image']))
+
+
+class TestEvalSummaries:
+
+  def test_eval_writes_heatmap_images_and_histograms(self, tmp_path):
+    """The model's add_summaries lands in the eval event files
+    (the reference's add_summaries path, ref :224-245)."""
+    from tensor2robot_tpu.trainer.metrics import read_events
+
+    model = grasp2vec.Grasp2VecModel(
+        scene_size=(56, 56), goal_size=(56, 56), resnet_size=18,
+        preprocessor_cls=lambda f, l: grasp2vec.Grasp2VecPreprocessor(
+            f, l, scene_crop=(0, 8, 56, 0, 8, 56),
+            goal_crop=(0, 8, 56, 0, 8, 56), src_img_shape=(64, 64, 3)))
+    generator = DefaultRandomInputGenerator(batch_size=8)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    state = trainer.train(generator, max_train_steps=1)
+    trainer.evaluate(generator, eval_steps=1, state=state)
+    trainer.close()
+    events = read_events(str(tmp_path / 'eval'))
+    tags = {tag for _, values in events for tag in values}
+    assert any(t.startswith('goal_pregrasp_map') for t in tags), tags
+    assert 'correct_distances' in tags

@@ -324,16 +324,18 @@ class TestDeviceCEMPolicy:
         0, 255, (512, 640, 3), dtype=np.uint8),
            'gripper_closed': 0.0, 'height_to_bottom': 0.1}
     rng = jax.random.PRNGKey(7)
-    baseline = np.asarray(select(variables, obs, rng))
+    baseline, baseline_q = select(variables, obs, rng)
+    baseline = np.asarray(baseline)
+    assert np.isfinite(float(baseline_q))
     # Corrupting raw params must NOT change the action...
     corrupted_raw = dict(variables)
     corrupted_raw['params'] = jax.tree.map(lambda x: x + 10.0,
                                            variables['params'])
     np.testing.assert_allclose(
-        np.asarray(select(corrupted_raw, obs, rng)), baseline)
+        np.asarray(select(corrupted_raw, obs, rng)[0]), baseline)
     # ...while corrupting avg_params must.
     corrupted_avg = dict(variables)
     corrupted_avg['avg_params'] = jax.tree.map(lambda x: x + 10.0,
                                                variables['avg_params'])
-    assert not np.allclose(np.asarray(select(corrupted_avg, obs, rng)),
+    assert not np.allclose(np.asarray(select(corrupted_avg, obs, rng)[0]),
                            baseline)

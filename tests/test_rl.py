@@ -112,8 +112,10 @@ def test_collect_eval_loop_single_pass(tmp_path):
       run_agent_fn=run_agent_fn, root_dir=str(tmp_path), continuous=False)
   assert [c[0] for c in calls] == ['collect', 'eval']
   assert calls[0][1] == 5 and calls[1][1] == 2
-  assert calls[0][3].endswith('policy_collect')
-  assert calls[1][3].endswith('eval')
+  # root_dir passes straight through (run_env adds policy_<tag> itself,
+  # ref continuous_collect_eval.py:100-107).
+  assert calls[0][3] == str(tmp_path)
+  assert calls[1][3] == str(tmp_path)
 
 
 def test_collect_eval_loop_continuous_stops_at_max_steps(tmp_path):
