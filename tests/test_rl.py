@@ -1,5 +1,6 @@
 """Collect/eval loop tests (ref continuous_collect_eval + run_env behavior)."""
 
+import glob
 import json
 import os
 
@@ -164,3 +165,74 @@ def test_collect_eval_loop_min_step_gate(tmp_path):
       policy_class=lambda: _ConstPolicy(step=0), num_collect=1,
       run_agent_fn=run_agent_fn, root_dir=str(tmp_path),
       min_collect_eval_step=100, poll_sleep_secs=0.01, max_poll_attempts=3)
+
+
+def test_concurrent_trainer_and_collector_hot_swap(tmp_path):
+  """The full distributed-RL transport, with REAL concurrency: a trainer
+  exporting per checkpoint while a robot-side CEM policy polls the export
+  dir, hot-swaps to newer versions, and writes replay records
+  (SURVEY §2.9 'filesystem as the actor<->learner transport').
+  """
+  import functools
+  import threading
+
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRandomInputGenerator,
+  )
+  from tensor2robot_tpu.hooks import AsyncExportHookBuilder
+  from tensor2robot_tpu.policies import CEMPolicy
+  from tensor2robot_tpu.predictors import ExportedModelPredictor
+  from tensor2robot_tpu.research.pose_env import (
+      PoseEnvContinuousMCModel,
+      PoseToyEnv,
+      episode_to_transitions_pose_toy,
+  )
+  from tensor2robot_tpu.trainer import train_eval_model
+
+  model_dir = str(tmp_path / 'train')
+  collect_root = str(tmp_path / 'robot')
+  train_errors = []
+
+  def train_job():
+    try:
+      train_eval_model(
+          PoseEnvContinuousMCModel(), model_dir,
+          input_generator_train=DefaultRandomInputGenerator(batch_size=8),
+          max_train_steps=6,
+          train_hook_builders=[AsyncExportHookBuilder(save_steps=2)],
+          async_checkpoints=False, save_checkpoints_steps=10**9,
+          write_metrics=False)
+    except BaseException as e:  # surfaced after join
+      train_errors.append(e)
+
+  trainer_thread = threading.Thread(target=train_job, daemon=True)
+  trainer_thread.start()
+
+  serving_model = PoseEnvContinuousMCModel(action_batch_size=8)
+  # Short restore timeout: the collect loop's own polling retries, so a
+  # trainer failure fails this test fast instead of compounding waits.
+  predictor = ExportedModelPredictor(
+      os.path.join(model_dir, 'export', 'latest_exporter'),
+      t2r_model=serving_model, timeout=2.0)
+  policy = CEMPolicy(t2r_model=serving_model, action_size=2, cem_iters=1,
+                     cem_samples=8, num_elites=2, predictor=predictor)
+  env = PoseToyEnv(seed=9)
+  try:
+    collect_eval_loop(
+        collect_env=env, eval_env=None, policy_class=lambda: policy,
+        num_collect=1, root_dir=collect_root, continuous=True, max_steps=5,
+        run_agent_fn=functools.partial(
+            run_env,
+            episode_to_transitions_fn=episode_to_transitions_pose_toy,
+            replay_writer=TFRecordReplayWriter(), close_env=False),
+        poll_sleep_secs=0.2, max_poll_attempts=100)
+    assert not train_errors, train_errors
+    # The policy saw a real (non-initial) exported version + wrote replay.
+    assert predictor.global_step >= 5
+    records = glob.glob(os.path.join(collect_root, 'policy_collect', '*'))
+    assert records, 'no replay records written by the collector'
+  finally:
+    trainer_thread.join(timeout=300)
+    env.close()
+    predictor.close()
+  assert not trainer_thread.is_alive()
