@@ -38,6 +38,77 @@ def _tf_dtype(np_dtype):
   return tf.dtypes.as_dtype(np.dtype(np_dtype))
 
 
+# -- TF-Serving warmup requests ----------------------------------------------
+#
+# The reference writes assets.extra/tf_serving_warmup_requests — a TFRecord
+# of tensorflow_serving PredictionLog protos (ref
+# abstract_export_generator.py:114-147) that TF-Serving replays at model
+# load to pre-trigger compilation. The tensorflow_serving proto package is
+# not a dependency here; the messages involved are tiny and are emitted
+# directly with the wire codec:
+#
+#   PredictionLog { PredictLog predict_log = 6; }
+#   PredictLog    { PredictRequest request = 1; }
+#   PredictRequest{ ModelSpec model_spec = 1;
+#                   map<string, TensorProto> inputs = 2; }
+#   ModelSpec     { string name = 1; string signature_name = 3; }
+#
+# TensorProto/TensorShapeProto come from TF core (dtype=1, tensor_shape=2,
+# tensor_content=4) and are verified against tf.make_ndarray in tests.
+
+
+def _encode_tensor_proto(value: np.ndarray) -> bytes:
+  from tensor2robot_tpu.data.wire import _emit_bytes_field, _write_varint
+
+  value = np.ascontiguousarray(value)
+  out = bytearray()
+  _write_varint(out, (1 << 3) | 0)  # dtype
+  _write_varint(out, int(_tf_dtype(value.dtype).as_datatype_enum))
+  shape = bytearray()
+  for size in value.shape:
+    dim = bytearray()
+    _write_varint(dim, (1 << 3) | 0)
+    _write_varint(dim, int(size))
+    _emit_bytes_field(shape, 2, bytes(dim))
+  _emit_bytes_field(out, 2, bytes(shape))
+  _emit_bytes_field(out, 4, value.tobytes())  # tensor_content, little-endian
+  return bytes(out)
+
+
+def encode_prediction_log(inputs, model_name: str = 'default',
+                          signature_name: str = 'serving_default') -> bytes:
+  """One serialized PredictionLog carrying a PredictRequest of ``inputs``."""
+  from tensor2robot_tpu.data.wire import _emit_bytes_field
+
+  model_spec = bytearray()
+  _emit_bytes_field(model_spec, 1, model_name.encode('utf-8'))
+  _emit_bytes_field(model_spec, 3, signature_name.encode('utf-8'))
+  request = bytearray()
+  _emit_bytes_field(request, 1, bytes(model_spec))
+  for key in sorted(inputs):
+    entry = bytearray()
+    _emit_bytes_field(entry, 1, key.encode('utf-8'))
+    _emit_bytes_field(entry, 2,
+                      _encode_tensor_proto(np.asarray(inputs[key])))
+    _emit_bytes_field(request, 2, bytes(entry))
+  predict_log = bytearray()
+  _emit_bytes_field(predict_log, 1, bytes(request))
+  prediction_log = bytearray()
+  _emit_bytes_field(prediction_log, 6, bytes(predict_log))
+  return bytes(prediction_log)
+
+
+def write_tf_serving_warmup_requests(path: str, inputs,
+                                     model_name: str = 'default',
+                                     signature_name: str = 'serving_default'
+                                     ) -> None:
+  """assets.extra/tf_serving_warmup_requests (ref :114-147)."""
+  from tensor2robot_tpu.data import tfrecord
+
+  tfrecord.write_records(path, [
+      encode_prediction_log(inputs, model_name, signature_name)])
+
+
 class TFSavedModelExportGenerator(export_generators.AbstractExportGenerator):
   """Exports versioned TF SavedModels instead of native artifacts."""
 
@@ -101,6 +172,9 @@ class TFSavedModelExportGenerator(export_generators.AbstractExportGenerator):
     np.savez(os.path.join(tmp_dir,
                           export_generators.WARMUP_REQUESTS_FILENAME),
              **{k: np.asarray(v) for k, v in warmup.items()})
+    write_tf_serving_warmup_requests(
+        os.path.join(tmp_dir, assets_lib.EXTRA_ASSETS_DIRECTORY,
+                     'tf_serving_warmup_requests'), warmup)
     os.rename(tmp_dir, final_dir)
     return final_dir
 

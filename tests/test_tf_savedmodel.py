@@ -77,3 +77,52 @@ class TestTFSavedModelExport:
     tf_out = signature(tf.constant([record]))
     value = tf_out['inference_output']
     assert value.shape[0] == 1 and np.all(np.isfinite(value.numpy()))
+
+
+class TestTFServingWarmup:
+
+  def test_tensor_proto_parses_with_tf(self):
+    """Hand-encoded TensorProto bytes == what TF itself decodes."""
+    from tensorflow.core.framework import tensor_pb2
+    import tensorflow as tf
+
+    from tensor2robot_tpu.export.tf_savedmodel import _encode_tensor_proto
+
+    for value in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.arange(6, dtype=np.int64).reshape(2, 3),
+                  np.random.RandomState(0).randint(
+                      0, 255, (2, 4, 4, 3), dtype=np.uint8)):
+      proto = tensor_pb2.TensorProto.FromString(
+          _encode_tensor_proto(value))
+      np.testing.assert_array_equal(tf.make_ndarray(proto), value)
+
+  def test_warmup_file_written_with_parseable_request(self, exported):
+    """The assets.extra warmup TFRecord frames a PredictionLog whose
+    request carries the spec'd input tensors (ref :114-147)."""
+    from tensorflow.core.framework import tensor_pb2
+    import tensorflow as tf
+
+    from tensor2robot_tpu.data.tfrecord import read_all_records
+    from tensor2robot_tpu.data.wire import _iter_fields
+
+    _, _, path = exported
+    warmup_path = os.path.join(path, 'assets.extra',
+                               'tf_serving_warmup_requests')
+    (record,) = read_all_records(warmup_path)
+
+    def _field(buf, number):
+      for field, wire_type, span in _iter_fields(buf, 0, len(buf)):
+        if field == number and wire_type == 2:
+          return buf[span[0]:span[1]]
+      raise AssertionError('field {} missing'.format(number))
+
+    predict_log = _field(record, 6)          # PredictionLog.predict_log
+    request = _field(predict_log, 1)         # PredictLog.request
+    model_spec = _field(request, 1)          # PredictRequest.model_spec
+    assert _field(model_spec, 3) == b'serving_default'
+    entry = _field(request, 2)               # inputs map entry
+    key = _field(entry, 1).decode('utf-8')
+    assert key == 'state'  # the pose model's flat in-spec key
+    tensor = tensor_pb2.TensorProto.FromString(_field(entry, 2))
+    decoded = tf.make_ndarray(tensor)
+    assert decoded.shape == (1, 64, 64, 3) and decoded.dtype == np.uint8
