@@ -281,3 +281,12 @@ def build_sequence_example(context: Dict[str, object],
     _emit_bytes_field(lists, 1, bytes(entry))
   _emit_bytes_field(out, 2, bytes(lists))
   return bytes(out)
+
+
+# -- public low-level codec surface ------------------------------------------
+# Consumers outside the Example codec (metrics events, TF-Serving warmup
+# protos) emit/walk wire-format messages with these.
+
+emit_bytes_field = _emit_bytes_field
+write_varint = _write_varint
+iter_fields = _iter_fields

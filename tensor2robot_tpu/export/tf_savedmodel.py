@@ -58,43 +58,51 @@ def _tf_dtype(np_dtype):
 
 
 def _encode_tensor_proto(value: np.ndarray) -> bytes:
-  from tensor2robot_tpu.data.wire import _emit_bytes_field, _write_varint
+  from tensor2robot_tpu.data.wire import emit_bytes_field, write_varint
 
   value = np.ascontiguousarray(value)
+  is_string = value.dtype == np.dtype(object) or value.dtype.kind in 'SU'
   out = bytearray()
-  _write_varint(out, (1 << 3) | 0)  # dtype
-  _write_varint(out, int(_tf_dtype(value.dtype).as_datatype_enum))
+  write_varint(out, (1 << 3) | 0)  # dtype
+  write_varint(out, 7 if is_string else  # DT_STRING
+               int(_tf_dtype(value.dtype).as_datatype_enum))
   shape = bytearray()
   for size in value.shape:
     dim = bytearray()
-    _write_varint(dim, (1 << 3) | 0)
-    _write_varint(dim, int(size))
-    _emit_bytes_field(shape, 2, bytes(dim))
-  _emit_bytes_field(out, 2, bytes(shape))
-  _emit_bytes_field(out, 4, value.tobytes())  # tensor_content, little-endian
+    write_varint(dim, (1 << 3) | 0)
+    write_varint(dim, int(size))
+    emit_bytes_field(shape, 2, bytes(dim))
+  emit_bytes_field(out, 2, bytes(shape))
+  if is_string:
+    # DT_STRING payloads live in string_val (field 8), NOT tensor_content.
+    for item in value.ravel():
+      data = item if isinstance(item, bytes) else str(item).encode('utf-8')
+      emit_bytes_field(out, 8, data)
+  else:
+    emit_bytes_field(out, 4, value.tobytes())  # tensor_content, LE bytes
   return bytes(out)
 
 
 def encode_prediction_log(inputs, model_name: str = 'default',
                           signature_name: str = 'serving_default') -> bytes:
   """One serialized PredictionLog carrying a PredictRequest of ``inputs``."""
-  from tensor2robot_tpu.data.wire import _emit_bytes_field
+  from tensor2robot_tpu.data.wire import emit_bytes_field
 
   model_spec = bytearray()
-  _emit_bytes_field(model_spec, 1, model_name.encode('utf-8'))
-  _emit_bytes_field(model_spec, 3, signature_name.encode('utf-8'))
+  emit_bytes_field(model_spec, 1, model_name.encode('utf-8'))
+  emit_bytes_field(model_spec, 3, signature_name.encode('utf-8'))
   request = bytearray()
-  _emit_bytes_field(request, 1, bytes(model_spec))
+  emit_bytes_field(request, 1, bytes(model_spec))
   for key in sorted(inputs):
     entry = bytearray()
-    _emit_bytes_field(entry, 1, key.encode('utf-8'))
-    _emit_bytes_field(entry, 2,
+    emit_bytes_field(entry, 1, key.encode('utf-8'))
+    emit_bytes_field(entry, 2,
                       _encode_tensor_proto(np.asarray(inputs[key])))
-    _emit_bytes_field(request, 2, bytes(entry))
+    emit_bytes_field(request, 2, bytes(entry))
   predict_log = bytearray()
-  _emit_bytes_field(predict_log, 1, bytes(request))
+  emit_bytes_field(predict_log, 1, bytes(request))
   prediction_log = bytearray()
-  _emit_bytes_field(prediction_log, 6, bytes(predict_log))
+  emit_bytes_field(prediction_log, 6, bytes(predict_log))
   return bytes(prediction_log)
 
 

@@ -32,21 +32,21 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from tensor2robot_tpu.data.tfrecord import TFRecordWriter
-from tensor2robot_tpu.data.wire import _emit_bytes_field, _write_varint
+from tensor2robot_tpu.data.wire import emit_bytes_field, write_varint
 
 
 def _emit_varint_field(out: bytearray, field: int, value: int) -> None:
-  _write_varint(out, (field << 3) | 0)
-  _write_varint(out, value & 0xFFFFFFFFFFFFFFFF)
+  write_varint(out, (field << 3) | 0)
+  write_varint(out, value & 0xFFFFFFFFFFFFFFFF)
 
 
 def _emit_double_field(out: bytearray, field: int, value: float) -> None:
-  _write_varint(out, (field << 3) | 1)
+  write_varint(out, (field << 3) | 1)
   out.extend(struct.pack('<d', value))
 
 
 def _emit_float_field(out: bytearray, field: int, value: float) -> None:
-  _write_varint(out, (field << 3) | 5)
+  write_varint(out, (field << 3) | 5)
   out.extend(struct.pack('<f', value))
 
 
@@ -67,7 +67,7 @@ def _encode_image(image: np.ndarray) -> bytes:
   _emit_varint_field(out, 1, height)
   _emit_varint_field(out, 2, width)
   _emit_varint_field(out, 3, colorspace)
-  _emit_bytes_field(out, 4, buf.getvalue())
+  emit_bytes_field(out, 4, buf.getvalue())
   return bytes(out)
 
 
@@ -104,8 +104,8 @@ def _encode_histogram(values: np.ndarray) -> bytes:
     for i in range(last):
       limits.extend(struct.pack('<d', min(_BUCKET_LIMITS[i], 1e308)))
       buckets.extend(struct.pack('<d', float(counts[i])))
-    _emit_bytes_field(out, 6, bytes(limits))  # packed repeated double
-    _emit_bytes_field(out, 7, bytes(buckets))
+    emit_bytes_field(out, 6, bytes(limits))  # packed repeated double
+    emit_bytes_field(out, 7, bytes(buckets))
   return bytes(out)
 
 
@@ -113,13 +113,13 @@ def _encode_value(tag: str, *, simple_value: Optional[float] = None,
                   image: Optional[np.ndarray] = None,
                   histogram: Optional[np.ndarray] = None) -> bytes:
   out = bytearray()
-  _emit_bytes_field(out, 1, tag.encode('utf-8'))
+  emit_bytes_field(out, 1, tag.encode('utf-8'))
   if simple_value is not None:
     _emit_float_field(out, 2, float(simple_value))
   if image is not None:
-    _emit_bytes_field(out, 4, _encode_image(image))
+    emit_bytes_field(out, 4, _encode_image(image))
   if histogram is not None:
-    _emit_bytes_field(out, 5, _encode_histogram(histogram))
+    emit_bytes_field(out, 5, _encode_histogram(histogram))
   return bytes(out)
 
 
@@ -130,12 +130,12 @@ def _encode_event(step: int, values: Sequence[bytes] = (),
   _emit_double_field(out, 1, time.time() if wall_time is None else wall_time)
   _emit_varint_field(out, 2, int(step))
   if file_version is not None:
-    _emit_bytes_field(out, 3, file_version.encode('utf-8'))
+    emit_bytes_field(out, 3, file_version.encode('utf-8'))
   if values:
     summary = bytearray()
     for value in values:
-      _emit_bytes_field(summary, 1, value)
-    _emit_bytes_field(out, 5, bytes(summary))
+      emit_bytes_field(summary, 1, value)
+    emit_bytes_field(out, 5, bytes(summary))
   return bytes(out)
 
 
@@ -189,7 +189,7 @@ def read_events(log_dir: str):
   by exporter compare-fns.
   """
   from tensor2robot_tpu.data.tfrecord import tfrecord_iterator
-  from tensor2robot_tpu.data.wire import _iter_fields
+  from tensor2robot_tpu.data.wire import iter_fields
 
   events = []
   for name in sorted(os.listdir(log_dir)):
@@ -199,14 +199,14 @@ def read_events(log_dir: str):
       step = 0
       tags: Dict[str, object] = {}
       summary_payload = None
-      for field, wire_type, value in _iter_fields(record, 0, len(record)):
+      for field, wire_type, value in iter_fields(record, 0, len(record)):
         if field == 2 and wire_type == 0:
           step = value
         elif field == 5 and wire_type == 2:
           summary_payload = record[value[0]:value[1]]
       if summary_payload is None:
         continue
-      for field, wire_type, value in _iter_fields(summary_payload, 0,
+      for field, wire_type, value in iter_fields(summary_payload, 0,
                                                   len(summary_payload)):
         if field != 1 or wire_type != 2:
           continue
@@ -219,14 +219,14 @@ def read_events(log_dir: str):
 
 
 def _parse_summary_value(payload: bytes):
-  from tensor2robot_tpu.data.wire import _iter_fields
+  from tensor2robot_tpu.data.wire import iter_fields
 
   def _bytes(span):
     return payload[span[0]:span[1]]
 
   tag = None
   parsed = None
-  for field, wire_type, value in _iter_fields(payload, 0, len(payload)):
+  for field, wire_type, value in iter_fields(payload, 0, len(payload)):
     if field == 1 and wire_type == 2:
       tag = _bytes(value).decode('utf-8')
     elif field == 2 and wire_type == 5:
@@ -234,7 +234,7 @@ def _parse_summary_value(payload: bytes):
     elif field == 4 and wire_type == 2:
       sub = _bytes(value)
       image = {}
-      for f2, w2, v2 in _iter_fields(sub, 0, len(sub)):
+      for f2, w2, v2 in iter_fields(sub, 0, len(sub)):
         if f2 == 1 and w2 == 0:
           image['height'] = v2
         elif f2 == 2 and w2 == 0:
@@ -246,7 +246,7 @@ def _parse_summary_value(payload: bytes):
       sub = _bytes(value)
       histo = {}
       names = {1: 'min', 2: 'max', 3: 'num', 4: 'sum', 5: 'sum_squares'}
-      for f2, w2, v2 in _iter_fields(sub, 0, len(sub)):
+      for f2, w2, v2 in iter_fields(sub, 0, len(sub)):
         if f2 in names and w2 == 1:
           histo[names[f2]] = struct.unpack('<d', sub[v2[0]:v2[1]])[0]
       parsed = histo

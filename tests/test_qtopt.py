@@ -339,3 +339,38 @@ class TestDeviceCEMPolicy:
                                                variables['avg_params'])
     assert not np.allclose(np.asarray(select(corrupted_avg, obs, rng)[0]),
                            baseline)
+
+
+class TestArchitectureParity:
+
+  def test_full_network_layer_inventory(self):
+    """The default Grasping44 matches the reference's 19-layer inventory
+    (ref networks.py:304-622): conv1_1 + conv2..16, 2 fc hiddens, logit,
+    per-block grasp-param denses. Shapes via eval_shape — no compute."""
+    net = networks.Grasping44Network(
+        grasp_param_names=networks.E2E_GRASP_PARAM_NAMES)
+    image = jax.ShapeDtypeStruct((1, 472, 472, 3), jnp.float32)
+    grasp = jax.ShapeDtypeStruct((1, 10), jnp.float32)
+    variables = jax.eval_shape(net.init, jax.random.PRNGKey(0), image,
+                               grasp)
+    params = variables['params']
+    conv_names = {k for k in params if k.startswith('conv')}
+    assert conv_names == {'conv1_1'} | {
+        'conv{}'.format(i) for i in range(2, 17)}
+    for name in conv_names:
+      assert params[name]['kernel'].shape[-1] == 64  # all towers 64-wide
+    # Grasp-param branch: one 256-dense per action block + the merge dense.
+    grasp_denses = {k for k in params if k.startswith('fcgrasp')}
+    assert grasp_denses == set(networks.E2E_GRASP_PARAM_NAMES) | {'fcgrasp2'}
+    for key in networks.E2E_GRASP_PARAM_NAMES:
+      offset, size = networks.E2E_GRASP_PARAM_NAMES[key]
+      assert params[key]['kernel'].shape == (size, 256)
+    assert params['fcgrasp2']['kernel'].shape == (256, 64)
+    # Head: two 64-wide hiddens + scalar logit (ref hid_layers=2).
+    assert params['fc0']['kernel'].shape[-1] == 64
+    assert params['fc1']['kernel'].shape[-1] == 64
+    assert params['logit']['kernel'].shape[-1] == 1
+    # Final conv spatial size: 472 -> 236 -> 79 -> 27 -> 14 -> 8 (3 VALIDs).
+    endpoints = jax.eval_shape(net.apply, variables, image, grasp)
+    assert endpoints['final_conv'].shape == (1, 8, 8, 64)
+    assert endpoints['predictions'].shape == (1,)

@@ -103,7 +103,7 @@ class TestTFServingWarmup:
     import tensorflow as tf
 
     from tensor2robot_tpu.data.tfrecord import read_all_records
-    from tensor2robot_tpu.data.wire import _iter_fields
+    from tensor2robot_tpu.data.wire import iter_fields
 
     _, _, path = exported
     warmup_path = os.path.join(path, 'assets.extra',
@@ -111,7 +111,7 @@ class TestTFServingWarmup:
     (record,) = read_all_records(warmup_path)
 
     def _field(buf, number):
-      for field, wire_type, span in _iter_fields(buf, 0, len(buf)):
+      for field, wire_type, span in iter_fields(buf, 0, len(buf)):
         if field == number and wire_type == 2:
           return buf[span[0]:span[1]]
       raise AssertionError('field {} missing'.format(number))
@@ -126,3 +126,17 @@ class TestTFServingWarmup:
     tensor = tensor_pb2.TensorProto.FromString(_field(entry, 2))
     decoded = tf.make_ndarray(tensor)
     assert decoded.shape == (1, 64, 64, 3) and decoded.dtype == np.uint8
+
+  def test_string_tensor_uses_string_val(self):
+    """DT_STRING payloads must use string_val, not tensor_content."""
+    from tensorflow.core.framework import tensor_pb2
+    import tensorflow as tf
+
+    from tensor2robot_tpu.export.tf_savedmodel import _encode_tensor_proto
+
+    value = np.empty((2,), dtype=object)
+    value[:] = [b'hello', b'world']
+    proto = tensor_pb2.TensorProto.FromString(_encode_tensor_proto(value))
+    decoded = tf.make_ndarray(proto)
+    np.testing.assert_array_equal(decoded, np.asarray([b'hello', b'world'],
+                                                      dtype=object))
