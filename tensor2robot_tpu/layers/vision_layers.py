@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tensor2robot_tpu.layers.pooling import max_pool
 from tensor2robot_tpu.layers.spatial_softmax import spatial_softmax
 
 _CHANNELS_PER_BLOCK = 32
@@ -129,7 +130,7 @@ class ImagesToFeaturesHighResNet(nn.Module):
     tap = conv(32, 1, 1, 'conv2_1x1')(net)
     block_outs.append(norm_relu(tap, False, 'norm2_1x1'))
     for i in range(1, self.num_blocks):
-      net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
+      net = max_pool(net, (2, 2), strides=(2, 2), padding='VALID')
       net = conv(32, self.filter_size, 1, 'conv{:d}'.format(i + 2))(net)
       net = norm_relu(net, False, 'norm{:d}'.format(i + 2))
       tap = conv(32, 1, 1, 'conv{:d}_1x1'.format(i + 2))(net)

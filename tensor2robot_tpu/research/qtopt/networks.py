@@ -29,7 +29,10 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.pooling import max_pool
 
 NUM_LAYERS = 19
 BATCH_SIZE = 64
@@ -54,6 +57,107 @@ E2E_GRASP_PARAM_KEYS = (
     'terminate_episode', 'gripper_closed', 'height_to_bottom')
 
 
+class _StemConv(nn.Module):
+  """conv1_1 — 6x6/2 on [B, H, W, 3], bias kept for reference parity.
+
+  Matches the reference stem exactly (ref networks.py:449-456:
+  ``slim.conv2d(..., normalizer_fn=None)`` — so unlike every later conv
+  this one HAS a bias). Two TPU notes:
+
+  * In TRAIN mode the bias is applied through ``stop_gradient``: the
+    following batch norm subtracts the batch mean, so the train loss is
+    invariant to the bias and its true gradient is identically zero —
+    but computing that zero costs a dead 1.8 GB reduction over the
+    236x236 cotangent per step. The parameter still exists
+    (checkpoint/parity) and still shifts the BN running statistics
+    exactly as in the reference. With ``train=False`` (frozen-stats
+    fine-tuning) the invariance does NOT hold — the bias gradient flows
+    normally there.
+  * ``packed=True`` computes the strided conv as 3x3/1 on the
+    2x2-space-to-depth grid — every output is the same dot product over
+    the same 108 inputs. Default OFF: on v5e, XLA's strided conv emitter
+    beats the packed form (measured 3.4 ms vs 4.6 ms at batch 256 even
+    with the packing relayout excluded); the option is kept, tested, for
+    generations where it wins.
+  """
+
+  packed: bool = False
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, x, train: bool = False):
+    kernel = self.param('kernel',
+                        nn.initializers.truncated_normal(stddev=0.01),
+                        (6, 6, 3, 64), jnp.float32)
+    bias = self.param('bias', nn.initializers.zeros, (64,), jnp.float32)
+    b, h, w, c = x.shape
+    x = jnp.asarray(x, self.dtype)
+    if self.packed and h % 2 == 0 and w % 2 == 0:
+      # [B, H, W, 3] -> [B, H/2, W/2, 12] with channel order (p, q, ch).
+      xp = x.reshape(b, h // 2, 2, w // 2, 2, c)
+      xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+      # kernel[2a+p, 2b+q, ch, co] -> packed[a, b, (p, q, ch), co].
+      kp = jnp.asarray(kernel, self.dtype).reshape(3, 2, 3, 2, c, 64)
+      kp = kp.transpose(0, 2, 1, 3, 4, 5).reshape(3, 3, 4 * c, 64)
+      # SAME for 6x6/2 on even H pads (2, 2); on the packed grid: (1, 1).
+      out = jax.lax.conv_general_dilated(
+          xp, kp, (1, 1), ((1, 1), (1, 1)),
+          dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+          preferred_element_type=self.dtype)
+    else:
+      out = jax.lax.conv_general_dilated(
+          x, jnp.asarray(kernel, self.dtype), (2, 2), 'SAME',
+          dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+          preferred_element_type=self.dtype)
+    bias = jnp.asarray(bias, self.dtype)
+    return out + (jax.lax.stop_gradient(bias) if train else bias)
+
+
+class _PrePoolStatsBatchNorm(nn.Module):
+  """No-scale BatchNorm whose TRAIN statistics come from the pre-pool map.
+
+  Grasping44's first block is conv1 -> bn1(no scale) -> relu -> maxpool.
+  Normalize-then-relu is a per-channel NON-DECREASING map, so it commutes
+  exactly with max pooling; evaluating it AFTER the pool touches the
+  79x79 map instead of the 236x236 one (8.9x less elementwise/HBM work)
+  while the batch statistics are still computed over the full pre-pool
+  tensor — bit-identical outputs and running stats. Parameter and
+  batch_stats trees match ``nn.BatchNorm(use_scale=False)``.
+  """
+
+  momentum: float = 0.9997
+  epsilon: float = 0.001
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, pre_pool, pooled, train: bool):
+    features = (pre_pool.shape[-1],)
+    ra_mean = self.variable('batch_stats', 'mean',
+                            lambda: jnp.zeros(features, jnp.float32))
+    ra_var = self.variable('batch_stats', 'var',
+                           lambda: jnp.ones(features, jnp.float32))
+    bias = self.param('bias', nn.initializers.zeros, features, jnp.float32)
+    if train:
+      xf = jnp.asarray(pre_pool, jnp.float32)
+      axes = tuple(range(pre_pool.ndim - 1))
+      mean = jnp.mean(xf, axis=axes)
+      var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+      if not self.is_initializing():
+        ra_mean.value = (self.momentum * ra_mean.value +
+                         (1.0 - self.momentum) * mean)
+        ra_var.value = (self.momentum * ra_var.value +
+                        (1.0 - self.momentum) * var)
+    else:
+      mean, var = ra_mean.value, ra_var.value
+    # Same arithmetic flax's BatchNorm applies: operands cast to the
+    # module dtype first, normalize computed in that dtype.
+    x = jnp.asarray(pooled, self.dtype)
+    mul = jax.lax.rsqrt(jnp.asarray(var, self.dtype) +
+                        jnp.asarray(self.epsilon, self.dtype))
+    return ((x - jnp.asarray(mean, self.dtype)) * mul +
+            jnp.asarray(bias, self.dtype))
+
+
 class Grasping44Network(nn.Module):
   """The Grasping44 Q-network (ref Grasping44FlexibleGraspParams :304)."""
 
@@ -66,17 +170,25 @@ class Grasping44Network(nn.Module):
   grasp_param_names: Optional[Dict[str, Tuple[int, int]]] = None
   softmax: bool = False
   dtype: jnp.dtype = jnp.float32
+  # Optional exact space-to-depth rewrite of the stem conv; see
+  # _StemConv for the trade-off measurements.
+  space_to_depth: bool = False
 
   def _conv(self, features, kernel, stride, padding, name):
+    # BN-normalized convs carry NO bias, exactly like slim.conv2d under
+    # the reference's normalizer_fn=batch_norm arg_scope (ref :441-446).
     return nn.Conv(
         features=features, kernel_size=(kernel, kernel),
-        strides=(stride, stride), padding=padding, use_bias=True,
+        strides=(stride, stride), padding=padding, use_bias=False,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         dtype=self.dtype, name=name)
 
-  def _dense(self, features, name):
+  def _dense(self, features, name, use_bias=True):
+    # use_bias=False for the BN-normalized denses (fcgrasp2, fc0/fc1 —
+    # same slim arg_scope rule); the per-block grasp-param denses and
+    # the logit head keep theirs (ref :497-503, :575-581).
     return nn.Dense(
-        features,
+        features, use_bias=use_bias,
         kernel_init=nn.initializers.truncated_normal(stddev=0.01),
         dtype=self.dtype, name=name)
 
@@ -106,16 +218,22 @@ class Grasping44Network(nn.Module):
       grasp_params = grasp_params.reshape((-1, grasp_params.shape[-1]))
 
     net = jnp.asarray(image, self.dtype)
-    net = self._conv(64, 6, 2, 'SAME', 'conv1_1')(net)
-    net = nn.relu(self._bn(net, train, scale=False, name='bn1'))
-    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    net = _StemConv(packed=self.space_to_depth, dtype=self.dtype,
+                    name='conv1_1')(net, train=train)
+    # Pool the RAW conv output; normalize+relu (a non-decreasing
+    # per-channel map — bn1 has no scale) on the 8.9x smaller pooled map
+    # with statistics still taken over the full pre-pool tensor.
+    pooled = max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    net = nn.relu(_PrePoolStatsBatchNorm(
+        momentum=self.batch_norm_decay, epsilon=self.batch_norm_epsilon,
+        dtype=self.dtype, name='bn1')(net, pooled, train))
     layer = 2
     for _ in range(self.num_convs[0]):
       net = self._conv(64, 5, 1, 'SAME', 'conv{}'.format(layer))(net)
       net = self._bn(net, train, True, 'bn{}'.format(layer))
       net = nn.relu(net)
       layer += 1
-    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
+    net = max_pool(net, (3, 3), strides=(3, 3), padding='SAME')
     endpoints['pool2'] = net
 
     grasp_params = jnp.asarray(grasp_params, self.dtype)
@@ -129,7 +247,7 @@ class Grasping44Network(nn.Module):
       ]
     fcgrasp = sum(self._dense(256, name)(block) for name, block in blocks)
     fcgrasp = nn.relu(self._bn(fcgrasp, train, scale=False, name='bngrasp'))
-    fcgrasp = self._dense(64, 'fcgrasp2')(fcgrasp)
+    fcgrasp = self._dense(64, 'fcgrasp2', use_bias=False)(fcgrasp)
     fcgrasp = nn.relu(self._bn(fcgrasp, train, True, 'bngrasp2'))
     endpoints['fcgrasp'] = fcgrasp
     context = fcgrasp.reshape((-1, 1, 1, 64))
@@ -147,7 +265,7 @@ class Grasping44Network(nn.Module):
       net = self._bn(net, train, True, 'bn{}'.format(layer))
       net = nn.relu(net)
       layer += 1
-    net = nn.max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
+    net = max_pool(net, (2, 2), strides=(2, 2), padding='SAME')
     for _ in range(self.num_convs[2]):
       net = self._conv(64, 3, 1, 'VALID', 'conv{}'.format(layer))(net)
       net = self._bn(net, train, True, 'bn{}'.format(layer))
@@ -157,7 +275,7 @@ class Grasping44Network(nn.Module):
 
     net = net.reshape((net.shape[0], -1))
     for l in range(self.hid_layers):
-      net = self._dense(64, 'fc{}'.format(l))(net)
+      net = self._dense(64, 'fc{}'.format(l), use_bias=False)(net)
       net = self._bn(net, train, True, 'bnfc{}'.format(l))
       net = nn.relu(net)
     name = 'logit' if self.num_classes == 1 else 'logit_{}'.format(

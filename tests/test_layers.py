@@ -305,3 +305,67 @@ class TestTec:
     loss_misaligned = tec.compute_embedding_contrastive_loss(
         inf_emb, jnp.asarray(con_bad))
     assert float(loss_aligned) < float(loss_misaligned)
+
+
+class TestFastMaxPool:
+  """pooling.max_pool == nn.max_pool in value AND gradient.
+
+  The custom-VJP path replaces XLA select-and-scatter (measured 10x
+  slower than the surrounding convs on TPU) for non-overlapping pools;
+  parity with the reference semantics (reduce-window max + first-match
+  scatter, ref slim max_pool2d usage networks.py:333) is what these
+  tests pin down.
+  """
+
+  CASES = [
+      ((2, 236, 236, 3), (3, 3), 'SAME'),
+      ((2, 79, 79, 4), (3, 3), 'SAME'),
+      ((2, 27, 27, 4), (2, 2), 'SAME'),
+      ((2, 28, 28, 4), (2, 2), 'VALID'),
+      ((2, 29, 29, 4), (3, 3), 'VALID'),  # non-divisible: tail cropped
+      ((1, 8, 10, 2), (2, 2), 'VALID'),
+  ]
+
+  @pytest.mark.parametrize('shape,window,padding', CASES)
+  def test_value_and_grad_match_reference(self, shape, window, padding):
+    from tensor2robot_tpu.layers import pooling
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+    import flax.linen as nn
+    want = nn.max_pool(x, window, strides=window, padding=padding)
+    got = pooling.max_pool(x, window, strides=window, padding=padding)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def loss_ref(x):
+      return jnp.sum(jnp.sin(
+          nn.max_pool(x, window, strides=window, padding=padding)))
+
+    def loss_fast(x):
+      return jnp.sum(jnp.sin(
+          pooling.max_pool(x, window, strides=window, padding=padding)))
+
+    g_want = jax.grad(loss_ref)(x)
+    g_got = jax.grad(loss_fast)(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               atol=1e-6)
+
+  def test_tie_break_first_match(self):
+    """Equal window elements: gradient goes to the FIRST (row-major)."""
+    from tensor2robot_tpu.layers import pooling
+    import flax.linen as nn
+    x = jnp.ones((1, 4, 4, 1), jnp.float32)
+    g_ref = jax.grad(lambda x: jnp.sum(
+        nn.max_pool(x, (2, 2), strides=(2, 2), padding='VALID')))(x)
+    g_fast = jax.grad(lambda x: jnp.sum(
+        pooling.max_pool(x, (2, 2), strides=(2, 2), padding='VALID')))(x)
+    np.testing.assert_array_equal(np.asarray(g_fast), np.asarray(g_ref))
+
+  def test_overlapping_falls_back(self):
+    from tensor2robot_tpu.layers import pooling
+    import flax.linen as nn
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 9, 9, 3),
+                    jnp.float32)
+    want = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+    got = pooling.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))

@@ -359,6 +359,17 @@ class TestArchitectureParity:
         'conv{}'.format(i) for i in range(2, 17)}
     for name in conv_names:
       assert params[name]['kernel'].shape[-1] == 64  # all towers 64-wide
+    # Bias convention matches slim's normalizer_fn rule (ref :441-456):
+    # BN-normalized convs/denses have NO bias; conv1_1 (normalizer_fn
+    # None), the per-block grasp-param denses, and the logit head keep
+    # theirs.
+    for name in conv_names - {'conv1_1'}:
+      assert 'bias' not in params[name], name
+    assert 'bias' in params['conv1_1']
+    for name in ('fcgrasp2', 'fc0', 'fc1'):
+      assert 'bias' not in params[name], name
+    for name in tuple(networks.E2E_GRASP_PARAM_NAMES) + ('logit',):
+      assert 'bias' in params[name], name
     # Grasp-param branch: one 256-dense per action block + the merge dense.
     grasp_denses = {k for k in params if k.startswith('fcgrasp')}
     assert grasp_denses == set(networks.E2E_GRASP_PARAM_NAMES) | {'fcgrasp2'}
@@ -374,3 +385,75 @@ class TestArchitectureParity:
     endpoints = jax.eval_shape(net.apply, variables, image, grasp)
     assert endpoints['final_conv'].shape == (1, 8, 8, 64)
     assert endpoints['predictions'].shape == (1,)
+
+
+class TestStemRewrites:
+  """The TPU stem transforms are exact rewrites, not approximations."""
+
+  def test_space_to_depth_conv1_matches_plain_conv(self):
+    """Identical params, identical outputs (same dot products; the
+    packed layout only changes summation order)."""
+    net_plain = networks.Grasping44Network(
+        grasp_param_names=networks.E2E_GRASP_PARAM_NAMES,
+        num_convs=(1, 1, 1), hid_layers=1, space_to_depth=False)
+    net_s2d = networks.Grasping44Network(
+        grasp_param_names=networks.E2E_GRASP_PARAM_NAMES,
+        num_convs=(1, 1, 1), hid_layers=1, space_to_depth=True)
+    rng = np.random.RandomState(0)
+    image = jnp.asarray(rng.rand(2, 472, 472, 3).astype(np.float32))
+    grasp = jnp.asarray(rng.randn(2, 10).astype(np.float32))
+    variables = net_plain.init(jax.random.PRNGKey(0), image, grasp,
+                               train=True)
+    # Same parameter tree in both configurations.
+    chex = jax.tree_util.tree_structure(
+        net_s2d.init(jax.random.PRNGKey(0), image, grasp, train=True))
+    assert jax.tree_util.tree_structure(variables) == chex
+    out_plain = net_plain.apply(variables, image, grasp)
+    out_s2d = net_s2d.apply(variables, image, grasp)
+    np.testing.assert_allclose(np.asarray(out_s2d['logits']),
+                               np.asarray(out_plain['logits']),
+                               rtol=2e-4, atol=2e-5)
+
+  @pytest.mark.parametrize('train', [True, False])
+  def test_pool_commuted_bn_matches_naive_order(self, train):
+    """pool(relu(bn(x))) == relu(bn_pooledstats(pool(x))) exactly: the
+    no-scale normalize+relu is per-channel non-decreasing."""
+    import flax.linen as nn
+    from tensor2robot_tpu.layers import pooling
+
+    momentum, eps = 0.9, 1e-3
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 13, 13, 4).astype(np.float32))
+
+    bn_ref = nn.BatchNorm(use_running_average=not train, momentum=momentum,
+                          epsilon=eps, use_scale=False)
+    variables = bn_ref.init(jax.random.PRNGKey(0), x)
+    variables = jax.tree.map(
+        lambda v: v + 0.1 * rng.randn(*v.shape).astype(v.dtype), variables)
+
+    def naive(x, variables):
+      y, updates = bn_ref.apply(variables, x, mutable=['batch_stats'])
+      return (nn.max_pool(nn.relu(y), (3, 3), strides=(3, 3),
+                          padding='SAME'), updates)
+
+    fused_mod = networks._PrePoolStatsBatchNorm(momentum=momentum,
+                                                epsilon=eps)
+    def fused(x, variables):
+      pooled = pooling.max_pool(x, (3, 3), strides=(3, 3), padding='SAME')
+      y, updates = fused_mod.apply(variables, x, pooled, train,
+                                   mutable=['batch_stats'])
+      return nn.relu(y), updates
+
+    want, want_updates = naive(x, variables)
+    got, got_updates = fused(x, variables)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-6),
+        got_updates, want_updates)
+
+    g_want = jax.grad(lambda x: jnp.sum(naive(x, variables)[0]))(x)
+    g_got = jax.grad(lambda x: jnp.sum(fused(x, variables)[0]))(x)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_want),
+                               rtol=1e-5, atol=1e-6)
