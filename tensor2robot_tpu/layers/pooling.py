@@ -115,11 +115,13 @@ def _max_pool_bwd(window, padding, res, dy):
 _max_pool_nonoverlap.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
-# Above this many input elements the index path's materialized
-# intermediates (padded copy, index grids, one-hot broadcasts) cost more
-# HBM traffic than select-and-scatter itself; measured crossover on a
-# v5e with the QT-Opt maps: 79x79x64 wins 4x, 236x236x64 loses 2x.
-_INDEX_PATH_MAX_ELEMENTS = 200_000_000
+# Above this many elements PER IMAGE (H*W*C — the crossover is a spatial
+# property; both paths scale linearly in batch) the index path's
+# materialized intermediates (padded copy, index grids, one-hot
+# broadcasts) cost more HBM traffic than select-and-scatter itself;
+# measured on a v5e with the QT-Opt maps: 79x79x64 (400k) wins 4x,
+# 236x236x64 (3.6M) loses 2x.
+_INDEX_PATH_MAX_ELEMENTS_PER_IMAGE = 1_000_000
 
 
 def max_pool(x: jnp.ndarray, window_shape: Sequence[int],
@@ -136,6 +138,6 @@ def max_pool(x: jnp.ndarray, window_shape: Sequence[int],
   if (window_shape == strides and x.ndim == 4 and
       padding in ('SAME', 'VALID') and
       max(window_shape) <= 127 and  # index grids are int8
-      x.size <= _INDEX_PATH_MAX_ELEMENTS):
+      x.size // x.shape[0] <= _INDEX_PATH_MAX_ELEMENTS_PER_IMAGE):
     return _max_pool_nonoverlap(x, window_shape, padding)
   return nn.max_pool(x, window_shape, strides=strides, padding=padding)
