@@ -1,0 +1,258 @@
+"""Pallas TPU kernel: non-overlapping max pool with index-routed backward.
+
+STATUS — correct, NOT wired into the hot path. This was the SURVEY §7
+"Pallas where the profile says so" investigation for the QT-Opt stem
+pool (236x236x64, the one map too large for pooling.py's XLA index
+path). Measured verdict on v5e at batch 256, fwd+bwd per step:
+reduce-window + select-and-scatter 20.2 ms, this kernel 37.3 ms —
+**XLA wins**. The op is VPU-bound, and the formulations Mosaic accepts
+force ~3x redundant element work: no strided sublane slices and no
+sublane-splitting reshapes exist, so the column stage must compute
+max/argmax at EVERY column position (stride-1 shifted slices) and then
+downsample via a 0/1 selection-matrix matmul; bf16 vector compares are
+unsupported, forcing f32 staging (2x the VPU traffic); i1-select
+relayouts are rejected, forcing arithmetic selects (extra multiplies).
+The kernel stays as the measured record of that finding (documented in
+docs/performance.md), with interpret-mode parity tests in
+tests/test_layers.py pinning its numerics.
+
+  forward:  per (batch, row-band) tile: row-stage strictly-greater
+            max/argmax chain, all-positions column stage, matmul
+            downsample; writes pooled map + int8 window-index grid.
+  backward: matmul-upsamples (idx, dy) to column resolution, routes dy
+            by in-window position match, leading-dim stacks the wh row
+            contributions, writes the dx tile once.
+
+Tie rule: first maximal element stage-wise (rows within a column, then
+columns) — identical to pooling.py's XLA index path; differs from
+select-and-scatter only on bit-exact ties, where the routed cell choice
+is immaterial (gradient mass is conserved either way).
+
+Geometry: window == strides (non-overlapping), NHWC, and zero LOW
+padding in both spatial dims — i.e. SAME with at most one padded
+row/column at the high end (236->79 has exactly that) or VALID.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Output rows computed per program instance; 8 keeps the input tile
+# (R*wh rows x W x C) under ~1 MB for the 236x236x64 target shape.
+_BLOCK_OUT_ROWS = 8
+
+
+def supported(x_shape: Tuple[int, ...], window: Tuple[int, int],
+              padding: str) -> bool:
+  """True if (shape, window, padding) fits this kernel's geometry."""
+  if len(x_shape) != 4:
+    return False
+  _, h, w, _ = x_shape
+  wh, ww = window
+  if padding == 'VALID':
+    return h >= wh and w >= ww
+  if padding != 'SAME':
+    return False
+  # SAME with stride == window pads (out*win - size) split low/high with
+  # low = total // 2; zero low padding means total pad <= 1 per dim.
+  return (-h) % wh <= 1 and (-w) % ww <= 1
+
+
+def _geometry(size: int, window: int, padding: str) -> int:
+  if padding == 'VALID':
+    return size // window
+  return -(-size // window)
+
+
+def _fwd_kernel(x_ref, out_ref, idx_ref, *, R, wh, ww, H, W, C, Ho, Wo):
+  band = pl.program_id(1)
+  # All staging in f32: the v5e VPU has no native bf16 compare, and the
+  # tiles are small enough (~1.5 MB at the 236x236x64 target) that the
+  # wider compute dtype is free.
+  x = x_ref[0].astype(jnp.float32)                 # [R*wh, W, C]
+  if Ho * wh > H:  # SAME high-pad row: mask rows past the input edge
+    row = (jax.lax.broadcasted_iota(jnp.int32, (R * wh, W, C), 0) +
+           band * R * wh)
+    # Rows past the edge are out-of-bounds block reads whose VMEM
+    # content is arbitrary stale bits (possibly NaN/Inf, which no
+    # multiply-by-zero scrub survives) — select them away in f32, where
+    # Mosaic's i1-select lowering works (the bf16 one is rejected).
+    x = jnp.where(row < H, x, jnp.asarray(-1e30, x.dtype))
+  xr = x.reshape(R, wh, W, C)                      # leading split: OK
+  # Row stage: strictly-greater chain keeps the first maximal row.
+  m1 = xr[:, 0]
+  i1 = jnp.zeros((R, W, C), jnp.int32)
+  for r in range(1, wh):
+    take = (xr[:, r] > m1).astype(jnp.int32)
+    m1 = jnp.maximum(m1, xr[:, r])
+    i1 = i1 * (1 - take) + r * take
+
+  wo_main = W // ww
+  tail = W - wo_main * ww                          # SAME: 0 or 1..ww-1
+  span = (wo_main - 1) * ww + 1
+
+  # Column stage, Mosaic-style: no strided sublane slices and no
+  # sublane-splitting reshapes exist, so compute the window max/argmax
+  # at EVERY column position with stride-1 shifted slices, then
+  # downsample (take every ww-th sublane) with a 0/1 selection-matrix
+  # matmul — a single-nonzero-per-row matmul copies values exactly.
+  mo_all = m1[:, :span]
+  io_all = jnp.zeros((R, span, C), jnp.int32)
+  for j in range(1, ww):
+    wc = m1[:, j:span + j]
+    take = (wc > mo_all).astype(jnp.int32)
+    mo_all = jnp.maximum(mo_all, wc)
+    io_all = io_all * (1 - take) + j * take
+  sel_all = i1[:, :span]
+  for j in range(1, ww):
+    eq = (io_all == j).astype(jnp.int32)
+    sel_all = sel_all * (1 - eq) + i1[:, j:span + j] * eq
+  k_all = sel_all * ww + io_all                    # [R, span, C]
+
+  # select[w, o] = 1 iff w == o*ww  (span x wo_main)
+  wpos = jax.lax.broadcasted_iota(jnp.int32, (span, wo_main), 0)
+  opos = jax.lax.broadcasted_iota(jnp.int32, (span, wo_main), 1)
+  select = (wpos == opos * ww).astype(jnp.float32)
+
+  def downsample(a):                 # [R, span, C] -> [R, wo_main, C]
+    d = jax.lax.dot_general(a.astype(jnp.float32), select,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return jnp.swapaxes(d, 1, 2)
+
+  out_ref[0, :, :wo_main, :] = downsample(mo_all).astype(out_ref.dtype)
+  idx_ref[0, :, :wo_main, :] = downsample(k_all).astype(jnp.int8)
+
+  if tail and Wo > wo_main:  # SAME: partial high-edge window (VALID
+    # crops the leftover instead — Wo == wo_main there, and storing a
+    # tail would clamp onto the last valid column)
+    mt = m1[:, wo_main * ww]
+    it = jnp.zeros((R, C), jnp.int32)
+    for j in range(1, tail):
+      wc = m1[:, wo_main * ww + j]
+      take = (wc > mt).astype(jnp.int32)
+      mt = jnp.maximum(mt, wc)
+      it = it * (1 - take) + j * take
+    selt = i1[:, wo_main * ww]
+    for j in range(1, tail):
+      eq = (it == j).astype(jnp.int32)
+      selt = selt * (1 - eq) + i1[:, wo_main * ww + j] * eq
+    out_ref[0, :, wo_main, :] = mt.astype(out_ref.dtype)
+    idx_ref[0, :, wo_main, :] = (selt * ww + it).astype(jnp.int8)
+
+
+def _bwd_kernel(idx_ref, dy_ref, dx_ref, *, R, wh, ww, H, W, C, Ho, Wo):
+  band = pl.program_id(1)
+  k = idx_ref[0].astype(jnp.int32)                 # [R, Wo, C]
+  dy = dy_ref[0].astype(jnp.float32)
+  # Mask output rows past Ho (the last band may overrun the output).
+  orow = jax.lax.broadcasted_iota(jnp.int32, (R, Wo, C), 0) + band * R
+  dy = dy * (orow < Ho).astype(dy.dtype)
+
+  # Upsample window index + cotangent to input-column resolution
+  # (up[w] = v[w // ww] — the window->column map, exact since low
+  # padding is zero) with a 0/1 selection-matrix matmul, the transpose
+  # of the forward's downsample. A single-nonzero-per-row matmul copies
+  # values exactly; int indices survive the f32 accumulate unchanged.
+  wmain = min(Wo * ww, W)  # < W only for VALID non-divisible widths
+  opos = jax.lax.broadcasted_iota(jnp.int32, (Wo, wmain), 0)
+  wpos = jax.lax.broadcasted_iota(jnp.int32, (Wo, wmain), 1)
+  spread = (opos == wpos // ww).astype(jnp.float32)
+
+  def upsample(a):                 # [R, Wo, C] -> [R, wmain, C]
+    d = jax.lax.dot_general(a.astype(jnp.float32), spread,
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return jnp.swapaxes(d, 1, 2)   # [R, C, wmain] -> [R, wmain, C]
+
+  k_up = upsample(k).astype(jnp.int32)
+  dy_up = upsample(dy).astype(dy.dtype)
+  col = jax.lax.broadcasted_iota(jnp.int32, (R, wmain, C), 1) % ww
+  contrib = dy_up * (k_up % ww == col).astype(dy.dtype)
+
+  r_up = k_up // ww
+  rows = [(contrib * (r_up == dr).astype(dy.dtype))[:, None]
+          for dr in range(wh)]
+  # [R, wh, wmain, C] (leading-dim stack) -> [R*wh, wmain, C] (leading
+  # merge), then zero-fill any VALID-cropped leftover columns.
+  dx_ref[0, :, :wmain, :] = jnp.concatenate(rows, axis=1).reshape(
+      R * wh, wmain, C).astype(dx_ref.dtype)
+  for j in range(W - wmain):
+    dx_ref[0, :, wmain + j, :] = jnp.zeros((R * wh, C), dx_ref.dtype)
+
+
+def _pallas_call_fwd(x, window, padding, interpret):
+  b, h, w, ch = x.shape
+  wh, ww = window
+  ho, wo = _geometry(h, wh, padding), _geometry(w, ww, padding)
+  nb = -(-ho // _BLOCK_OUT_ROWS)
+  kernel = functools.partial(_fwd_kernel, R=_BLOCK_OUT_ROWS, wh=wh, ww=ww,
+                             H=h, W=w, C=ch, Ho=ho, Wo=wo)
+  return pl.pallas_call(
+      kernel,
+      grid=(b, nb),
+      in_specs=[pl.BlockSpec((1, _BLOCK_OUT_ROWS * wh, w, ch),
+                             lambda b, i: (b, i, 0, 0),
+                             memory_space=pltpu.VMEM)],
+      out_specs=[
+          pl.BlockSpec((1, _BLOCK_OUT_ROWS, wo, ch),
+                       lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, _BLOCK_OUT_ROWS, wo, ch),
+                       lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((b, ho, wo, ch), x.dtype),
+          jax.ShapeDtypeStruct((b, ho, wo, ch), jnp.int8),
+      ],
+      interpret=interpret,
+  )(x)
+
+
+def _pallas_call_bwd(idx, dy, x_shape, window, padding, interpret):
+  b, h, w, ch = x_shape
+  wh, ww = window
+  ho, wo = idx.shape[1], idx.shape[2]
+  nb = -(-ho // _BLOCK_OUT_ROWS)
+  kernel = functools.partial(_bwd_kernel, R=_BLOCK_OUT_ROWS, wh=wh, ww=ww,
+                             H=h, W=w, C=ch, Ho=ho, Wo=wo)
+  return pl.pallas_call(
+      kernel,
+      grid=(b, nb),
+      in_specs=[
+          pl.BlockSpec((1, _BLOCK_OUT_ROWS, wo, ch),
+                       lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, _BLOCK_OUT_ROWS, wo, ch),
+                       lambda b, i: (b, i, 0, 0), memory_space=pltpu.VMEM),
+      ],
+      out_specs=pl.BlockSpec((1, _BLOCK_OUT_ROWS * wh, w, ch),
+                             lambda b, i: (b, i, 0, 0),
+                             memory_space=pltpu.VMEM),
+      out_shape=jax.ShapeDtypeStruct((b, h, w, ch), dy.dtype),
+      interpret=interpret,
+  )(idx, dy)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def max_pool_pallas(x, window, padding='SAME', interpret=False):
+  """Non-overlapping max pool; see module docstring for the geometry."""
+  out, _ = _pallas_call_fwd(x, window, padding, interpret)
+  return out
+
+
+def _vjp_fwd(x, window, padding, interpret):
+  out, idx = _pallas_call_fwd(x, window, padding, interpret)
+  return out, (idx, x.shape)
+
+
+def _vjp_bwd(window, padding, interpret, res, dy):
+  idx, x_shape = res
+  return (_pallas_call_bwd(idx, dy, x_shape, window, padding, interpret),)
+
+
+max_pool_pallas.defvjp(_vjp_fwd, _vjp_bwd)

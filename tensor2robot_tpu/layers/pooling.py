@@ -135,9 +135,12 @@ def max_pool(x: jnp.ndarray, window_shape: Sequence[int],
   reduce-window fallback by calling ``flax.linen.max_pool`` directly.
   """
   window_shape, strides = tuple(window_shape), tuple(strides)
+  per_image = 1
+  for d in x.shape[1:]:
+    per_image *= d
   if (window_shape == strides and x.ndim == 4 and
       padding in ('SAME', 'VALID') and
       max(window_shape) <= 127 and  # index grids are int8
-      x.size // x.shape[0] <= _INDEX_PATH_MAX_ELEMENTS_PER_IMAGE):
+      per_image <= _INDEX_PATH_MAX_ELEMENTS_PER_IMAGE):
     return _max_pool_nonoverlap(x, window_shape, padding)
   return nn.max_pool(x, window_shape, strides=strides, padding=padding)
