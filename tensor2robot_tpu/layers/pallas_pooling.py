@@ -55,6 +55,8 @@ def supported(x_shape: Tuple[int, ...], window: Tuple[int, int],
     return False
   _, h, w, _ = x_shape
   wh, ww = window
+  if wh * ww > 127:  # combined window index is stored int8
+    return False
   if padding == 'VALID':
     return h >= wh and w >= ww
   if padding != 'SAME':
@@ -121,8 +123,12 @@ def _fwd_kernel(x_ref, out_ref, idx_ref, *, R, wh, ww, H, W, C, Ho, Wo):
   select = (wpos == opos * ww).astype(jnp.float32)
 
   def downsample(a):                 # [R, span, C] -> [R, wo_main, C]
+    # HIGHEST precision: the default TPU matmul precision rounds f32
+    # operands to bf16, breaking the exact-copy invariant of the 0/1
+    # selection matmul.
     d = jax.lax.dot_general(a.astype(jnp.float32), select,
                             (((1,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST,
                             preferred_element_type=jnp.float32)
     return jnp.swapaxes(d, 1, 2)
 
@@ -166,8 +172,10 @@ def _bwd_kernel(idx_ref, dy_ref, dx_ref, *, R, wh, ww, H, W, C, Ho, Wo):
   spread = (opos == wpos // ww).astype(jnp.float32)
 
   def upsample(a):                 # [R, Wo, C] -> [R, wmain, C]
+    # HIGHEST precision for the same exact-copy reason as the forward.
     d = jax.lax.dot_general(a.astype(jnp.float32), spread,
                             (((1,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST,
                             preferred_element_type=jnp.float32)
     return jnp.swapaxes(d, 1, 2)   # [R, C, wmain] -> [R, wmain, C]
 

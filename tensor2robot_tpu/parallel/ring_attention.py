@@ -57,9 +57,11 @@ def _block_attention(q, k, v, o, m, l, q_offset, k_offset, causal,
   return o_new, m_new, l_new
 
 
-def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
-                          scale: float, use_pallas: bool):
-  """Per-shard body: local q attends to every k/v block as it rings past."""
+def _ring_forward(q, k, v, axis_name: str, causal: bool,
+                  scale: float, use_pallas: bool):
+  """Per-shard forward: local q attends to every k/v block as it rings
+  past. Returns (out [B,Lq,H,D], lse [B,Lq,H] f32) — the log-sum-exp is
+  the residual the memory-efficient backward recomputes p from."""
   axis_size = lax.psum(1, axis_name)
   my_index = lax.axis_index(axis_name)
   block_q = q.shape[1]
@@ -88,8 +90,82 @@ def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
     return o, m, l, k_next, v_next
 
   o, m, l, _, _ = lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
+  lse = m + jnp.log(jnp.maximum(l, 1e-30))
   l = jnp.maximum(l, 1e-20)
-  return (o / l[:, :, :, None]).astype(q.dtype)
+  return (o / l[:, :, :, None]).astype(q.dtype), lse
+
+
+def _ring_backward(axis_name, causal, scale, res, dout):
+  """Memory-efficient ring backward: recompute p blockwise per hop from
+  the saved log-sum-exp (never materializing more than one [Lq, Lk]
+  score block), and let the dk/dv accumulators RIDE THE RING with their
+  k/v blocks — after axis_size hops each accumulator is back on its home
+  device having collected contributions from every q shard. Per-device
+  persistent memory stays O(L/N * D), like the forward.
+  """
+  q, k, v, out, lse = res
+  axis_size = lax.psum(1, axis_name)
+  my_index = lax.axis_index(axis_name)
+  block_q, block_k = q.shape[1], k.shape[1]
+  qf = q.astype(jnp.float32)
+  do = dout.astype(jnp.float32)
+  # delta[b,q,h] = sum_d do*out — the softmax-jacobian diagonal term.
+  delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)
+  delta_bhq = jnp.transpose(delta, (0, 2, 1))[:, :, :, None]
+  safe_lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+  lse_bhq = jnp.transpose(safe_lse, (0, 2, 1))[:, :, :, None]
+
+  def body(i, carry):
+    dq, dk_cur, dv_cur, k_cur, v_cur = carry
+    src = (my_index - i) % axis_size
+    kf = k_cur.astype(jnp.float32)
+    vf = v_cur.astype(jnp.float32)
+    scores = jnp.einsum('bqhd,bkhd->bhqk', qf, kf) * scale
+    if causal:
+      q_pos = my_index * block_q + jnp.arange(block_q)
+      k_pos = src * block_k + jnp.arange(block_k)
+      mask = q_pos[:, None] >= k_pos[None, :]
+      scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jnp.exp(scores - lse_bhq)
+    p = jnp.where(scores <= NEG_INF / 2, 0.0, p)
+    dv_cur = dv_cur + jnp.einsum('bhqk,bqhd->bkhd', p, do)
+    dp = jnp.einsum('bqhd,bkhd->bhqk', do, vf)
+    ds = p * (dp - delta_bhq)
+    dq = dq + jnp.einsum('bhqk,bkhd->bqhd', ds, kf) * scale
+    dk_cur = dk_cur + jnp.einsum('bhqk,bqhd->bkhd', ds, qf) * scale
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    return (dq,
+            lax.ppermute(dk_cur, axis_name, perm),
+            lax.ppermute(dv_cur, axis_name, perm),
+            lax.ppermute(k_cur, axis_name, perm),
+            lax.ppermute(v_cur, axis_name, perm))
+
+  dq = jnp.zeros(q.shape, jnp.float32)
+  dkv = jnp.zeros(k.shape, jnp.float32)
+  dq, dk, dv, _, _ = lax.fori_loop(
+      0, axis_size, body, (dq, dkv, dkv, k, v))
+  return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_shard(q, k, v, axis_name: str, causal: bool,
+                          scale: float, use_pallas: bool):
+  """Differentiable per-shard ring attention (see _ring_forward)."""
+  out, _ = _ring_forward(q, k, v, axis_name, causal, scale, use_pallas)
+  return out
+
+
+def _ring_shard_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+  out, lse = _ring_forward(q, k, v, axis_name, causal, scale, use_pallas)
+  return out, (q, k, v, out, lse)
+
+
+def _ring_shard_bwd(axis_name, causal, scale, use_pallas, res, dout):
+  del use_pallas  # backward is the blockwise jnp path either way
+  return _ring_backward(axis_name, causal, scale, res, dout)
+
+
+_ring_attention_shard.defvjp(_ring_shard_fwd, _ring_shard_bwd)
 
 
 def _ring_shard_pallas(q, k, v, axis_name: str, causal: bool, scale: float,
@@ -128,9 +204,12 @@ def _ring_shard_pallas(q, k, v, axis_name: str, causal: bool, scale: float,
 
   o, m, l, _, _ = lax.fori_loop(
       0, axis_size, body, (o, m, l, _to_bhld(k), _to_bhld(v)))
+  lse = m + jnp.log(jnp.maximum(l, 1e-30))           # [B*H, Lq]
   l = jnp.maximum(l, 1e-20)
   out = o / l[:, :, None]
-  return out.reshape(b, h, block_q, d).transpose(0, 2, 1, 3).astype(q.dtype)
+  out = out.reshape(b, h, block_q, d).transpose(0, 2, 1, 3).astype(q.dtype)
+  lse = lse.reshape(b, h, block_q).transpose(0, 2, 1)  # [B, Lq, H]
+  return out, lse
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = 'data',
@@ -145,12 +224,15 @@ def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = 'data',
     seq_axis: mesh axis carrying sequence blocks.
     causal: apply a causal mask over *global* positions.
     scale: score scale; default 1/sqrt(D).
-    use_pallas: run each intra-shard block update through the Pallas
-      flash kernel (parallel/flash_attention.py) — no per-hop [Lq, Lk]
-      score tensor in HBM. FORWARD-ONLY (the carry kernel has no VJP);
-      default False so training code differentiates through the jnp
-      path. Opt in for inference/serving on TPU; requires per-device
-      shard lengths divisible by the kernel block sizes (<=128).
+    use_pallas: run each intra-shard FORWARD block update through the
+      Pallas flash kernel (parallel/flash_attention.py) — no per-hop
+      [Lq, Lk] score tensor in HBM. Requires per-device shard lengths
+      divisible by the kernel block sizes (<=128). Fully trainable
+      either way: the custom VJP recomputes p blockwise per hop from
+      the saved log-sum-exp and rotates the dk/dv accumulators around
+      the ring with their blocks, so TRAINING memory is O(L/N) per
+      device too (plain autodiff through the hop loop would have saved
+      every per-hop score tensor).
 
   Returns [B, L, H, D], sharded like q.
   """
