@@ -184,6 +184,11 @@ class Trainer:
                                                sharding=s),
           abstract_state, self._state_sharding)
       return self.checkpoint_manager.restore(template, step=latest)
+    # No checkpoint: this is a FRESH state. Callers chaining train() calls
+    # without checkpointing must thread the returned state explicitly or
+    # each call restarts from initialization — log so that's visible.
+    _log('No checkpoint in %s; initializing fresh train state.',
+         self.model_dir)
     if getattr(self.model, 'warm_start_fn', None) is not None:
       # Warm start restores a foreign checkpoint (real I/O): run it eagerly
       # exactly once and shard the result, instead of tracing it under jit

@@ -364,3 +364,99 @@ class TestPoseEnvMAML:
     assert os.path.exists(os.path.join(
         str(tmp_path / 'meta_env'), 'live_eval_0', 'metrics-collect.jsonl'))
     predictor.close()
+
+
+class TestMetaLabelPreprocessing:
+  """Outer-loss (meta) labels receive the SAME base label transform the
+  condition labels do (advisor round-1 finding: the reference splits
+  AFTER base preprocessing, ref preprocessors.py map_fn, so a label-
+  transforming base preprocessor must hit both paths identically)."""
+
+  def test_meta_labels_see_base_label_transform(self):
+    from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+        AbstractPreprocessor,
+    )
+
+    class _DoublingPreprocessor(AbstractPreprocessor):
+      """Base preprocessor that doubles every label value."""
+
+      def __init__(self, base_model):
+        self._m = base_model
+
+      def get_in_feature_specification(self, mode):
+        return self._m.get_feature_specification(mode)
+
+      def get_in_label_specification(self, mode):
+        return self._m.get_label_specification(mode)
+
+      def get_out_feature_specification(self, mode):
+        return self._m.get_feature_specification(mode)
+
+      def get_out_label_specification(self, mode):
+        return self._m.get_label_specification(mode)
+
+      def _preprocess_fn(self, features, labels, mode, rng=None):
+        if labels is not None:
+          labels = SpecStruct(
+              **{k: labels[k] * 2.0 for k in labels})
+        return features, labels
+
+    base = _LinearRegressionModel()
+    meta_pp = MAMLPreprocessorV2(_DoublingPreprocessor(base))
+    tasks, cond_n, inf_n = 2, 3, 2
+    features = SpecStruct()
+    features['condition/features/x'] = jnp.ones((tasks, cond_n, 1))
+    features['condition/labels/target'] = jnp.full((tasks, cond_n, 1), 5.0)
+    features['inference/features/x'] = jnp.ones((tasks, inf_n, 1))
+    labels = SpecStruct(target=jnp.full((tasks, inf_n, 1), 7.0))
+    out_f, out_l = meta_pp._preprocess_fn(features, labels,
+                                          ModeKeys.TRAIN)
+    np.testing.assert_allclose(
+        np.asarray(out_f['condition/labels/target']), 10.0)
+    # The fix under test: outer labels doubled too, not passed through.
+    np.testing.assert_allclose(np.asarray(out_l['target']), 14.0)
+
+
+class TestMAMLBatchStats:
+  """MAML training propagates the base model's BatchNorm running stats
+  (advisor round-1 finding: the inner loop used to discard mutable
+  collections, leaving batch_stats at init forever)."""
+
+  def test_batch_stats_update_through_maml_train_step(self, tmp_path):
+    import flax.linen as nn
+
+    class _BNNet(nn.Module):
+
+      @nn.compact
+      def __call__(self, features, mode='train', train=False):
+        x = nn.Dense(4)(features['x'])
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        return {'inference_output': nn.Dense(1)(x)}
+
+    class _BNRegressionModel(_LinearRegressionModel):
+
+      def create_network(self):
+        return _BNNet()
+
+    model = MAMLRegressionModel(base_model=_BNRegressionModel(),
+                                num_inner_loop_steps=1)
+    generator = MAMLRandomInputGenerator(
+        num_tasks=8, num_condition_samples_per_task=2,
+        num_inference_samples_per_task=2)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    state = trainer.train(generator, max_train_steps=2)
+    trainer.close()
+    bstats = (state.model_state or {}).get('batch_stats')
+    assert jax.tree_util.tree_leaves(bstats), (
+        'BN base model must surface batch_stats')
+    # The running MEANs must have moved off their zero init (the var
+    # leaves init to ONE, so select by path name, not position).
+    means = [leaf for path, leaf in
+             jax.tree_util.tree_flatten_with_path(bstats)[0]
+             if 'mean' in str(path[-1])]
+    assert means
+    moved = max(float(np.abs(np.asarray(jax.device_get(m))).max())
+                for m in means)
+    assert moved > 0.0, bstats
