@@ -168,13 +168,23 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
   ``file_patterns``: 'path/a*' or 'tfrecord:path/a*,path/b*'.
   ``dataset_map``: {dataset_key: file_patterns} for multi-dataset zip driven
   by the specs' ``dataset_key`` attributes.
+
+  When the specs qualify (plain tf.Example, fixed shapes, JPEG images), the
+  hot path runs on the native C++ loader (data/native/record_loader.cc):
+  multithreaded record read + proto parse + JPEG decode outside the GIL,
+  the analog of the reference's C++ tf.data pipeline
+  (utils/tfdata.py:527-575). ``use_native=False`` (or T2R_NATIVE_LOADER=0)
+  forces the pure-Python pipeline; 'auto' falls back silently when specs
+  are unsupported or the toolchain can't build the library.
   """
 
   def __init__(self, file_patterns: Optional[str] = None,
                dataset_map: Optional[Dict[str, str]] = None,
                batch_size: int = 32,
                shuffle_buffer_size: int = 500,
-               prefetch: int = 2):
+               prefetch: int = 2,
+               use_native: Union[bool, str] = 'auto',
+               num_native_threads: Optional[int] = None):
     super().__init__(batch_size=batch_size)
     if not file_patterns and not dataset_map:
       raise ValueError('file_patterns or dataset_map is required.')
@@ -184,13 +194,58 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     self._dataset_map = dataset_map
     self._shuffle_buffer_size = shuffle_buffer_size
     self._prefetch = prefetch
+    self._use_native = use_native
+    self._num_native_threads = num_native_threads
 
   def _dataset_files(self) -> Dict[str, str]:
     if self._dataset_map is not None:
       return dict(self._dataset_map)
     return {'': self._file_patterns}
 
+  def _native_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    """Returns a native-loader batch iterator, or None to fall back."""
+    from tensor2robot_tpu.data import native_loader
+
+    if self._use_native is False or not native_loader.native_loader_enabled():
+      return None
+    if self._dataset_map is not None:
+      if self._use_native is True:
+        raise ValueError(
+            'use_native=True but multi-dataset zip (dataset_map) is only '
+            'supported by the Python pipeline.')
+      return None  # multi-dataset zip stays on the Python path
+    plan = native_loader.plan_for_specs(self._feature_spec, self._label_spec)
+    if plan is None:
+      if self._use_native is True:
+        raise ValueError(
+            'use_native=True but the specs are not supported by the native '
+            'loader (sequences, varlen, optional, PNG, duplicate or unnamed '
+            'feature names).')
+      return None
+    try:
+      # Through _dataset_files() so subclass overrides (e.g. Fractional's
+      # file_fraction truncation) apply to the native path too.
+      _, files = parse_file_patterns(self._dataset_files()[''])
+      files = files[shard_index::num_shards]
+      if not files:
+        return None
+      stream = native_loader.NativeBatchedStream(
+          plan, files, batch_size=self._batch_size,
+          shuffle=(mode == ModeKeys.TRAIN),
+          shuffle_buffer=self._shuffle_buffer_size,
+          num_epochs=num_epochs, seed=seed,
+          num_threads=self._num_native_threads)
+    except RuntimeError:
+      if self._use_native is True:
+        raise
+      return None  # toolchain missing etc. — silent fallback
+    return iter(stream)
+
   def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    native = self._native_iterator(mode, num_epochs, shard_index,
+                                   num_shards, seed)
+    if native is not None:
+      return native
     parser = ExampleParser(self._feature_spec, self._label_spec)
     datasets = {
         key: RecordDataset(patterns, dataset_key=key,

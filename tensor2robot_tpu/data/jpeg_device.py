@@ -1,0 +1,141 @@
+"""Device-side JPEG finishing: DCT coefficients -> RGB pixels, in-jit.
+
+The TPU half of the split-decode input path. The native loader's
+``image_mode='coef'`` stops after entropy (Huffman) decode — the only
+inherently sequential stage of JPEG — and ships quantized DCT coefficient
+blocks to the device (data/native/record_loader.cc, decode_jpeg_coef). This
+module finishes the decode inside the jitted train step:
+
+    dequantize -> 8x8 IDCT (einsum, MXU) -> block reassembly -> chroma
+    upsample -> YCbCr -> RGB
+
+Why: host JPEG decode is the input bottleneck on CPU-poor hosts (SURVEY.md
+hard-part #3). Measured on one host core, entropy-only decode runs ~1.5x
+faster than full decode (the IDCT/upsample/color stages are the pixel-domain
+majority of decode cost), and the device-side finish is ~8 MFLOP per
+512x640 frame — noise next to the 25 GFLOP the QT-Opt critic spends per
+example. The reference has no analog (its tf.data pipeline decodes fully on
+host); this is a TPU-first redesign of the ingest path.
+
+Caveats: baseline 4:2:0 JPEGs with dims divisible by 16 (what the replay
+writer and any camera pipeline produce). Chroma upsampling matches
+libjpeg's default triangle filter in float arithmetic; together with the
+float YCbCr->RGB conversion (libjpeg uses fixed-point), decoded pixels sit
+within +/-4 of a host decode, 98% within +/-1 — below JPEG's own
+quantization noise (verified in tests/test_native_loader.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _idct_matrix() -> np.ndarray:
+  """8x8 DCT-III (inverse DCT-II) basis: x = B @ F @ B.T per block."""
+  k = np.arange(8)
+  n = np.arange(8)
+  basis = np.cos((2 * n[:, None] + 1) * k[None, :] * np.pi / 16)
+  alpha = np.full(8, np.sqrt(2.0 / 8.0))
+  alpha[0] = np.sqrt(1.0 / 8.0)
+  return (basis * alpha[None, :]).astype(np.float32)  # [n, k]
+
+
+_IDCT_B = _idct_matrix()
+
+
+def _blocks_to_plane(blocks: jnp.ndarray) -> jnp.ndarray:
+  """[B, Hb, Wb, 8, 8] pixel blocks -> [B, Hb*8, Wb*8] plane."""
+  b, hb, wb, _, _ = blocks.shape
+  return blocks.transpose(0, 1, 3, 2, 4).reshape(b, hb * 8, wb * 8)
+
+
+def _idct_plane(coef: jnp.ndarray, quant: jnp.ndarray) -> jnp.ndarray:
+  """Dequantize + 2D IDCT + level shift for one component.
+
+  coef: [B, Hb, Wb, 64] int16 quantized coefficients (natural order).
+  quant: [B, 64] uint16 quantization table.
+  Returns [B, Hb*8, Wb*8] float32 in [0, 255] (unclipped).
+  """
+  f = coef.astype(jnp.float32) * quant.astype(jnp.float32)[:, None, None, :]
+  f = f.reshape(f.shape[:3] + (8, 8))
+  basis = jnp.asarray(_IDCT_B)
+  # x[n, m] = sum_{k, l} B[n, k] F[k, l] B[m, l]
+  x = jnp.einsum('nk,bhwkl,ml->bhwnm', basis, f, basis)
+  return _blocks_to_plane(x) + 128.0
+
+
+def _upsample2x_nearest(plane: jnp.ndarray) -> jnp.ndarray:
+  """Nearest-neighbor 2x chroma upsample ([B, h, w] -> [B, 2h, 2w])."""
+  return jnp.repeat(jnp.repeat(plane, 2, axis=1), 2, axis=2)
+
+
+def _upsample2x_triangle(plane: jnp.ndarray) -> jnp.ndarray:
+  """libjpeg's default h2v2 'fancy' upsample: 3:1 triangle filter.
+
+  Vertical pass then horizontal pass; each output pixel is 3 parts nearest
+  input pixel, 1 part next-nearest, edges replicated (jdsample.c
+  h2v2_fancy_upsample, in float arithmetic).
+  """
+  p = plane
+  shift_up = jnp.concatenate([p[:, :1], p[:, :-1]], axis=1)
+  shift_dn = jnp.concatenate([p[:, 1:], p[:, -1:]], axis=1)
+  v_even = (3.0 * p + shift_up) * 0.25
+  v_odd = (3.0 * p + shift_dn) * 0.25
+  v = jnp.stack([v_even, v_odd], axis=2).reshape(
+      p.shape[0], -1, p.shape[2])
+  shift_l = jnp.concatenate([v[:, :, :1], v[:, :, :-1]], axis=2)
+  shift_r = jnp.concatenate([v[:, :, 1:], v[:, :, -1:]], axis=2)
+  h_even = (3.0 * v + shift_l) * 0.25
+  h_odd = (3.0 * v + shift_r) * 0.25
+  return jnp.stack([h_even, h_odd], axis=3).reshape(
+      v.shape[0], v.shape[1], -1)
+
+
+def decode_jpeg_coefficients(y: jnp.ndarray, cb: jnp.ndarray,
+                             cr: jnp.ndarray, qt: jnp.ndarray,
+                             dtype=jnp.uint8,
+                             fancy_upsample: bool = True) -> jnp.ndarray:
+  """Finishes a batch of 4:2:0 JPEGs from quantized DCT coefficients.
+
+  Args:
+    y:  [B, H/8, W/8, 64] int16 luma coefficient blocks.
+    cb: [B, H/16, W/16, 64] int16 chroma-blue blocks.
+    cr: [B, H/16, W/16, 64] int16 chroma-red blocks.
+    qt: [B, 3, 64] uint16 quant tables (luma, cb, cr — natural order).
+    dtype: output dtype; uint8 matches a host decode, float32 skips the
+      round-trip when the consumer immediately normalizes.
+    fancy_upsample: triangle-filter chroma upsample (libjpeg default
+      parity); False uses nearest (cheaper, coarser chroma edges).
+
+  Returns: [B, H, W, 3] RGB image batch.
+  """
+  upsample = _upsample2x_triangle if fancy_upsample else _upsample2x_nearest
+  luma = _idct_plane(y, qt[:, 0])
+  cb_p = upsample(_idct_plane(cb, qt[:, 1]))
+  cr_p = upsample(_idct_plane(cr, qt[:, 2]))
+  cb_c = cb_p - 128.0
+  cr_c = cr_p - 128.0
+  r = luma + 1.402 * cr_c
+  g = luma - 0.344136 * cb_c - 0.714136 * cr_c
+  b = luma + 1.772 * cb_c
+  rgb = jnp.stack([r, g, b], axis=-1)
+  rgb = jnp.clip(jnp.round(rgb), 0.0, 255.0)
+  return rgb.astype(dtype)
+
+
+def decode_coef_features(features, image_keys, dtype=jnp.uint8):
+  """Replaces ``key/{y,cb,cr,qt}`` coefficient groups with decoded ``key``.
+
+  The native loader in coef mode emits four arrays per image spec; call
+  this first inside the jitted step (before the preprocessor) to
+  materialize the spec's actual image tensor on device.
+  """
+  for key in image_keys:
+    y = features.pop(key + '/y')
+    cb = features.pop(key + '/cb')
+    cr = features.pop(key + '/cr')
+    qt = features.pop(key + '/qt')
+    features[key] = decode_jpeg_coefficients(y, cb, cr, qt, dtype=dtype)
+  return features

@@ -1,0 +1,959 @@
+// Native TFRecord -> batched-tensor loader.
+//
+// The reference feeds its models with a C++ tf.data pipeline
+// (/root/reference/utils/tfdata.py:527-575 drives TF's native record reader,
+// parallel_interleave and JPEG decode kernels). This is the equivalent native
+// runtime component for the TPU framework: a dependency-light C++ loader that
+// reads TFRecord shards, parses tf.Example protos straight off the wire
+// format, decodes JPEG frames with libjpeg(-turbo), and assembles batches
+// into a ring of preallocated buffers — all on a worker thread pool that
+// scales with host cores, entirely outside the Python GIL.
+//
+// Architecture:
+//   reader thread:  epoch loop -> framed record read -> bounded shuffle
+//                   buffer -> (slot, row) work items
+//   N worker threads: proto wire walk -> field extract / JPEG decode ->
+//                   write into slot row (no locks on the hot path; each row
+//                   is owned by exactly one worker)
+//   consumer (Python via ctypes): t2r_loader_next() blocks for a READY slot,
+//                   wraps the slot buffers as numpy arrays (zero copy),
+//                   t2r_loader_release() returns the slot to the pool.
+//
+// Decode modes per image field:
+//   image_full: full libjpeg decode to uint8 [H, W, C] rows.
+//   image_coef: entropy (Huffman) decode ONLY via jpeg_read_coefficients —
+//     the host-side half of the DCT-domain split-decode path. Outputs
+//     quantized DCT coefficient blocks + quant tables; dequant + IDCT +
+//     chroma upsample + YCbCr->RGB run on the TPU inside the jitted train
+//     step (see data/jpeg_device.py), putting the IDCT matmuls on the MXU
+//     and cutting host CPU cost to the entropy decode (measured ~1.5x less
+//     host time per frame than full decode).
+//
+// Wire-format notes (proto2/proto3 compatible, no protobuf dependency):
+//   Example        = { 1: Features }
+//   Features       = { 1: repeated map entry { 1: key-bytes, 2: Feature } }
+//   Feature        = oneof { 1: BytesList, 2: FloatList, 3: Int64List }
+//   BytesList      = { 1: repeated bytes }
+//   FloatList      = { 1: repeated float (packed or unpacked) }
+//   Int64List      = { 1: repeated varint (packed or unpacked) }
+//
+// TFRecord framing: [u64 len][u32 masked-crc32c(len)][data][u32 masked-crc32c
+// (data)] — see data/tfrecord.py for the Python twin of this reader.
+
+#include <pthread.h>
+#include <setjmp.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <jpeglib.h>  // requires <stddef.h>/<stdio.h> first
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli) for TFRecord frame verification.
+// ---------------------------------------------------------------------------
+
+uint32_t crc32c_table[256];
+std::once_flag crc_table_once;
+
+void init_crc_table() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int k = 0; k < 8; k++)
+      crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+    crc32c_table[i] = crc;
+  }
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+#if defined(__SSE4_2__)
+  uint64_t crc = 0xFFFFFFFFu;
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t v;
+    memcpy(&v, data + i, 8);
+    crc = _mm_crc32_u64(crc, v);
+  }
+  for (; i < n; i++) crc = _mm_crc32_u8((uint32_t)crc, data[i]);
+  return (uint32_t)crc ^ 0xFFFFFFFFu;
+#else
+  std::call_once(crc_table_once, init_crc_table);
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    crc = crc32c_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+#endif
+}
+
+uint32_t masked_crc(const uint8_t* data, size_t n) {
+  uint32_t crc = crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// ---------------------------------------------------------------------------
+// Protobuf wire walking.
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  // Returns field number, sets wire type; 0 on end/error.
+  uint32_t tag(uint32_t* wire_type) {
+    if (p >= end) return 0;
+    uint64_t t = varint();
+    if (!ok) return 0;
+    *wire_type = (uint32_t)(t & 7);
+    return (uint32_t)(t >> 3);
+  }
+
+  // Length-delimited payload; returns view.
+  Cursor bytes() {
+    uint64_t n = varint();
+    if (!ok || p + n > end) {
+      ok = false;
+      return {end, end};
+    }
+    Cursor c{p, p + n};
+    p += n;
+    return c;
+  }
+
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: varint(); break;
+      case 1: p += 8; break;
+      case 2: bytes(); break;
+      case 5: p += 4; break;
+      default: ok = false;
+    }
+    if (p > end) ok = false;
+  }
+
+  size_t size() const { return end - p; }
+};
+
+// ---------------------------------------------------------------------------
+// Config.
+// ---------------------------------------------------------------------------
+
+enum FieldKind { kFloat = 0, kInt = 1, kImageFull = 2, kImageCoef = 3 };
+
+struct FieldSpec {
+  std::string name;
+  FieldKind kind;
+  int dtype_size;  // int fields: output width in bytes (1, 4, 8)
+  int h = 0, w = 0, c = 0;  // image fields
+  long long count = 0;      // float/int fields: elements per row
+  // Buffer indices into Slot::buffers (filled at config time).
+  int buf0 = -1;            // primary (float/int/u8 pixels, or coef Y)
+  int buf_cb = -1, buf_cr = -1, buf_qt = -1;  // image_coef extras
+};
+
+struct Config {
+  int batch_size = 0;
+  int ring = 3;
+  int threads = 2;
+  bool shuffle = false;
+  int shuffle_buffer = 500;
+  long long seed = -1;
+  long long epochs = -1;  // -1: infinite
+  bool verify_crc = false;
+  std::vector<std::string> files;
+  std::vector<FieldSpec> fields;
+  std::vector<long long> buffer_sizes;  // per-slot bytes for each buffer
+};
+
+bool parse_config(const std::string& text, Config* cfg, std::string* err) {
+  std::istringstream in(text);
+  std::string key;
+  while (in >> key) {
+    if (key == "batch_size") in >> cfg->batch_size;
+    else if (key == "ring") in >> cfg->ring;
+    else if (key == "threads") in >> cfg->threads;
+    else if (key == "shuffle") { int v; in >> v; cfg->shuffle = v != 0; }
+    else if (key == "shuffle_buffer") in >> cfg->shuffle_buffer;
+    else if (key == "seed") in >> cfg->seed;
+    else if (key == "epochs") in >> cfg->epochs;
+    else if (key == "verify_crc") { int v; in >> v; cfg->verify_crc = v != 0; }
+    else if (key == "files") {
+      int n; in >> n;
+      in.ignore(1);
+      for (int i = 0; i < n; i++) {
+        std::string path;
+        std::getline(in, path);
+        if (path.empty()) { *err = "empty file path"; return false; }
+        cfg->files.push_back(path);
+      }
+    } else if (key == "fields") {
+      int m; in >> m;
+      for (int i = 0; i < m; i++) {
+        FieldSpec f;
+        int kind, name_len;
+        in >> name_len >> kind >> f.dtype_size >> f.h >> f.w >> f.c
+            >> f.count;
+        f.kind = (FieldKind)kind;
+        in.ignore(1);  // single separating space
+        f.name.resize(name_len);
+        in.read(&f.name[0], name_len);
+        cfg->fields.push_back(f);
+      }
+    } else {
+      *err = "unknown config key: " + key;
+      return false;
+    }
+  }
+  if (cfg->batch_size <= 0 || cfg->files.empty() || cfg->fields.empty()) {
+    *err = "config requires batch_size, files, fields";
+    return false;
+  }
+  if (cfg->ring < 2) cfg->ring = 2;
+  if (cfg->threads < 1) cfg->threads = 1;
+  // Assign buffers. Layout mirrored in native_loader.py (_field_buffers).
+  long long B = cfg->batch_size;
+  for (auto& f : cfg->fields) {
+    switch (f.kind) {
+      case kFloat:
+        f.buf0 = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * f.count * 4);
+        break;
+      case kInt:
+        f.buf0 = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * f.count * f.dtype_size);
+        break;
+      case kImageFull:
+        f.buf0 = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * (long long)f.h * f.w * f.c);
+        break;
+      case kImageCoef: {
+        if (f.h % 16 || f.w % 16 || f.c != 3) {
+          *err = "image_coef requires HxW multiple of 16 and c=3: " + f.name;
+          return false;
+        }
+        long long yblocks = (long long)(f.h / 8) * (f.w / 8);
+        long long cblocks = (long long)(f.h / 16) * (f.w / 16);
+        f.buf0 = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * yblocks * 64 * 2);
+        f.buf_cb = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * cblocks * 64 * 2);
+        f.buf_cr = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * cblocks * 64 * 2);
+        f.buf_qt = (int)cfg->buffer_sizes.size();
+        cfg->buffer_sizes.push_back(B * 3 * 64 * 2);
+        break;
+      }
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// JPEG decode.
+// ---------------------------------------------------------------------------
+
+struct JerrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jerr_exit(j_common_ptr cinfo) {
+  JerrMgr* e = (JerrMgr*)cinfo->err;
+  (*cinfo->err->format_message)(cinfo, e->msg);
+  longjmp(e->jb, 1);
+}
+
+// Full decode into row (H*W*C uint8). Returns error string or empty.
+std::string decode_jpeg_full(const uint8_t* data, size_t n,
+                             const FieldSpec& f, uint8_t* out) {
+  if (n == 0) {  // empty payload -> zeros (reference tfdata.py:444-455 parity)
+    memset(out, 0, (size_t)f.h * f.w * f.c);
+    return "";
+  }
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return std::string("jpeg: ") + jerr.msg;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, n);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = f.c == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if ((int)cinfo.output_width != f.w || (int)cinfo.output_height != f.h ||
+      (int)cinfo.output_components != f.c) {
+    jpeg_destroy_decompress(&cinfo);
+    char buf[160];
+    snprintf(buf, sizeof buf, "jpeg dims %dx%dx%d != spec %dx%dx%d for %s",
+             cinfo.output_height, cinfo.output_width, cinfo.output_components,
+             f.h, f.w, f.c, f.name.c_str());
+    return buf;
+  }
+  size_t stride = (size_t)f.w * f.c;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW rows[8];
+    int base = cinfo.output_scanline;
+    int navail = (int)(cinfo.output_height - base);
+    int nrows = navail < 8 ? navail : 8;
+    for (int k = 0; k < nrows; k++) rows[k] = out + (base + k) * stride;
+    jpeg_read_scanlines(&cinfo, rows, nrows);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return "";
+}
+
+// Entropy-only decode: quantized DCT coefficients + quant tables.
+// Requires baseline 4:2:0 (2x2,1x1,1x1 sampling) or 4:4:4 handled as error.
+std::string decode_jpeg_coef(const uint8_t* data, size_t n,
+                             const FieldSpec& f, int16_t* y, int16_t* cb,
+                             int16_t* cr, uint16_t* qt) {
+  const long long yblocks = (long long)(f.h / 8) * (f.w / 8);
+  const long long cblocks = (long long)(f.h / 16) * (f.w / 16);
+  if (n == 0) {
+    memset(y, 0, yblocks * 64 * 2);
+    memset(cb, 0, cblocks * 64 * 2);
+    memset(cr, 0, cblocks * 64 * 2);
+    // All-zero quant tables would decode to zeros regardless; use 1s so the
+    // device path's dequant multiply is well-defined.
+    for (int i = 0; i < 3 * 64; i++) qt[i] = 1;
+    return "";
+  }
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return std::string("jpeg: ") + jerr.msg;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, n);
+  jpeg_read_header(&cinfo, TRUE);
+  jvirt_barray_ptr* coefs = jpeg_read_coefficients(&cinfo);
+  if (cinfo.num_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef: not a 3-component JPEG: " + f.name;
+  }
+  if ((int)cinfo.image_width != f.w || (int)cinfo.image_height != f.h) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef: dims mismatch for " + f.name;
+  }
+  jpeg_component_info* ci = cinfo.comp_info;
+  if (ci[0].h_samp_factor != 2 || ci[0].v_samp_factor != 2 ||
+      ci[1].h_samp_factor != 1 || ci[1].v_samp_factor != 1 ||
+      ci[2].h_samp_factor != 1 || ci[2].v_samp_factor != 1) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef: requires 4:2:0 chroma subsampling: " + f.name;
+  }
+  int16_t* outs[3] = {y, cb, cr};
+  int bw[3] = {f.w / 8, f.w / 16, f.w / 16};
+  int bh[3] = {f.h / 8, f.h / 16, f.h / 16};
+  for (int comp = 0; comp < 3; comp++) {
+    // Quant table for this component.
+    JQUANT_TBL* tbl = ci[comp].quant_table
+                          ? ci[comp].quant_table
+                          : cinfo.quant_tbl_ptrs[ci[comp].quant_tbl_no];
+    if (!tbl) {
+      jpeg_destroy_decompress(&cinfo);
+      return "image_coef: missing quant table: " + f.name;
+    }
+    for (int i = 0; i < 64; i++) qt[comp * 64 + i] = tbl->quantval[i];
+    int16_t* out = outs[comp];
+    for (int br = 0; br < bh[comp]; br++) {
+      JBLOCKARRAY rows = (*cinfo.mem->access_virt_barray)(
+          (j_common_ptr)&cinfo, coefs[comp], br, 1, FALSE);
+      // libjpeg pads width_in_blocks to the MCU boundary; copy only the
+      // blocks covering the image (bw), dropping pad columns.
+      memcpy(out + (long long)br * bw[comp] * 64, rows[0][0],
+             (size_t)bw[comp] * 64 * 2);
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return "";
+}
+
+// ---------------------------------------------------------------------------
+// Loader.
+// ---------------------------------------------------------------------------
+
+enum SlotState { kFree, kFilling, kReady, kInUse };
+
+struct Slot {
+  std::vector<uint8_t*> buffers;
+  std::atomic<int> remaining{0};
+  SlotState state = kFree;
+  long long seq = -1;  // batch sequence number, for ordered hand-off
+};
+
+struct WorkItem {
+  std::string record;
+  int slot;
+  int row;
+};
+
+struct Loader {
+  Config cfg;
+  std::deque<Slot> slots;
+  std::mutex mu;
+  std::condition_variable cv_ready;    // consumer waits
+  std::condition_variable cv_free;     // reader waits for a free slot
+  std::condition_variable cv_work;     // workers wait
+  std::condition_variable cv_space;    // reader waits for queue space
+  std::deque<WorkItem> work;
+  std::deque<int> ready;               // READY slot indices in seq order
+  bool eof = false;                    // reader finished dispatching
+  std::atomic<bool> stop{false};
+  std::string error;
+  long long dispatched_batches = 0;
+  long long completed_batches = 0;
+  long long next_seq_out = 0;          // strict batch delivery order
+  std::vector<std::thread> threads;
+  std::thread reader;
+
+  ~Loader() { shutdown(); }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_work.notify_all();
+    cv_free.notify_all();
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    if (reader.joinable()) reader.join();
+    for (auto& t : threads)
+      if (t.joinable()) t.join();
+    for (auto& s : slots)
+      for (auto* b : s.buffers) free(b);
+    slots.clear();
+  }
+
+  void fail(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (error.empty()) error = msg;
+    stop = true;
+    cv_ready.notify_all();
+    cv_work.notify_all();
+    cv_free.notify_all();
+    cv_space.notify_all();
+  }
+
+  // ---- reader ------------------------------------------------------------
+
+  bool dispatch_row(std::string&& rec, int* cur_slot, int* cur_row,
+                    long long* seq) {
+    if (*cur_slot < 0) {  // acquire a free slot
+      std::unique_lock<std::mutex> lk(mu);
+      cv_free.wait(lk, [&] {
+        if (stop) return true;
+        for (auto& s : slots)
+          if (s.state == kFree) return true;
+        return false;
+      });
+      if (stop) return false;
+      for (size_t i = 0; i < slots.size(); i++) {
+        if (slots[i].state == kFree) {
+          slots[i].state = kFilling;
+          slots[i].remaining.store(cfg.batch_size);
+          slots[i].seq = (*seq)++;
+          *cur_slot = (int)i;
+          *cur_row = 0;
+          break;
+        }
+      }
+    }
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stop || work.size() < (size_t)(4 * cfg.threads + 64);
+      });
+      if (stop) return false;
+      work.push_back(WorkItem{std::move(rec), *cur_slot, *cur_row});
+    }
+    cv_work.notify_one();
+    if (++*cur_row == cfg.batch_size) {
+      *cur_slot = -1;
+      std::lock_guard<std::mutex> lk(mu);
+      dispatched_batches++;
+    }
+    return true;
+  }
+
+  void reader_main() {
+    std::mt19937_64 rng(cfg.seed >= 0 ? (uint64_t)cfg.seed
+                                      : std::random_device{}());
+    std::vector<std::string> shuffle_buf;
+    if (cfg.shuffle) shuffle_buf.reserve(cfg.shuffle_buffer);
+    int cur_slot = -1, cur_row = 0;
+    long long seq = 0;
+
+    auto emit = [&](std::string&& rec) -> bool {
+      if (!cfg.shuffle)
+        return dispatch_row(std::move(rec), &cur_slot, &cur_row, &seq);
+      shuffle_buf.push_back(std::move(rec));
+      if ((int)shuffle_buf.size() >= cfg.shuffle_buffer) {
+        size_t idx = rng() % shuffle_buf.size();
+        std::swap(shuffle_buf[idx], shuffle_buf.back());
+        std::string out = std::move(shuffle_buf.back());
+        shuffle_buf.pop_back();
+        return dispatch_row(std::move(out), &cur_slot, &cur_row, &seq);
+      }
+      return true;
+    };
+
+    long long epoch = 0;
+    std::vector<std::string> files = cfg.files;
+    while (cfg.epochs < 0 || epoch < cfg.epochs) {
+      if (cfg.shuffle)
+        std::shuffle(files.begin(), files.end(), rng);
+      for (const auto& path : files) {
+        FILE* f = fopen(path.c_str(), "rb");
+        if (!f) {
+          fail("cannot open " + path);
+          return;
+        }
+        fseek(f, 0, SEEK_END);
+        long file_size = ftell(f);
+        fseek(f, 0, SEEK_SET);
+        uint8_t header[12];
+        std::string rec;
+        while (fread(header, 1, 12, f) == 12) {
+          uint64_t len;
+          memcpy(&len, header, 8);
+          // Sanity-cap the untrusted length BEFORE resize: a corrupt frame
+          // (or a non-TFRecord file matched by the glob) must surface as a
+          // loader error, not a std::bad_alloc escaping the thread.
+          long pos = ftell(f);
+          if (pos < 0 || len > (uint64_t)(file_size - pos)) {
+            fclose(f);
+            fail("corrupt or non-TFRecord frame in " + path +
+                 " (record length exceeds file size)");
+            return;
+          }
+          if (cfg.verify_crc) {
+            uint32_t expect;
+            memcpy(&expect, header + 8, 4);
+            if (masked_crc(header, 8) != expect) {
+              fclose(f);
+              fail("corrupt TFRecord length CRC in " + path);
+              return;
+            }
+          }
+          rec.resize(len);
+          if (len > 0 && fread(&rec[0], 1, len, f) != len) {
+            fclose(f);
+            fail("truncated TFRecord in " + path);
+            return;
+          }
+          uint8_t footer[4];
+          if (fread(footer, 1, 4, f) != 4) {
+            fclose(f);
+            fail("truncated TFRecord in " + path);
+            return;
+          }
+          if (cfg.verify_crc) {
+            uint32_t expect;
+            memcpy(&expect, footer, 4);
+            if (masked_crc((const uint8_t*)rec.data(), rec.size()) != expect) {
+              fclose(f);
+              fail("corrupt TFRecord data CRC in " + path);
+              return;
+            }
+          }
+          if (!emit(std::move(rec))) {
+            fclose(f);
+            return;
+          }
+          rec.clear();
+        }
+        fclose(f);
+        if (stop) return;
+      }
+      epoch++;
+    }
+    // Flush shuffle buffer.
+    if (cfg.shuffle) {
+      std::shuffle(shuffle_buf.begin(), shuffle_buf.end(), rng);
+      for (auto& rec : shuffle_buf)
+        if (!dispatch_row(std::move(rec), &cur_slot, &cur_row, &seq)) return;
+    }
+    // Partial batch at end of data is dropped (drop_remainder=True parity,
+    // utils/tfdata.py:560-564): mark the half-filled slot free again.
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (cur_slot >= 0 && cur_row > 0) {
+        // 'remaining' was initialized to batch_size; subtract the rows that
+        // were never dispatched. Whoever's subtraction transitions the count
+        // to exactly 0 owns recycling the slot: if our fetch_sub consumed the
+        // whole residue (prev == subtracted), every dispatched row already
+        // finished and no worker will touch the slot again; otherwise the
+        // last in-flight worker sees prev==1 and checks seq == -2 (set here,
+        // under the same mutex its check takes).
+        int sub = cfg.batch_size - cur_row;
+        int prev = slots[cur_slot].remaining.fetch_sub(sub);
+        if (prev == sub)
+          slots[cur_slot].state = kFree;
+        else
+          slots[cur_slot].seq = -2;  // sentinel: discard on completion
+      }
+      eof = true;
+    }
+    cv_ready.notify_all();
+  }
+
+  // ---- workers -----------------------------------------------------------
+
+  std::string parse_into(const std::string& rec, int slot_idx, int row) {
+    Slot& slot = slots[slot_idx];
+    Cursor ex{(const uint8_t*)rec.data(),
+              (const uint8_t*)rec.data() + rec.size()};
+    // Track which fields were found.
+    std::vector<bool> found(cfg.fields.size(), false);
+    uint32_t wt;
+    while (uint32_t fnum = ex.tag(&wt)) {
+      if (fnum != 1 || wt != 2) {
+        ex.skip(wt);
+        continue;
+      }
+      Cursor features = ex.bytes();
+      while (uint32_t f2 = features.tag(&wt)) {
+        if (f2 != 1 || wt != 2) {
+          features.skip(wt);
+          continue;
+        }
+        Cursor entry = features.bytes();
+        // Map entry: key(1), value(2).
+        const uint8_t* key_p = nullptr;
+        size_t key_n = 0;
+        Cursor value{nullptr, nullptr};
+        while (uint32_t f3 = entry.tag(&wt)) {
+          if (f3 == 1 && wt == 2) {
+            Cursor k = entry.bytes();
+            key_p = k.p;
+            key_n = k.size();
+          } else if (f3 == 2 && wt == 2) {
+            value = entry.bytes();
+          } else {
+            entry.skip(wt);
+          }
+        }
+        if (!key_p || !value.p) continue;
+        // Match against configured fields (few fields; linear scan is fine
+        // and avoids hashing every record key).
+        int fi = -1;
+        for (size_t i = 0; i < cfg.fields.size(); i++) {
+          const std::string& nm = cfg.fields[i].name;
+          if (nm.size() == key_n && memcmp(nm.data(), key_p, key_n) == 0) {
+            fi = (int)i;
+            break;
+          }
+        }
+        if (fi < 0) continue;
+        found[fi] = true;
+        std::string err = extract_field(cfg.fields[fi], value, slot, row);
+        if (!err.empty()) return err;
+      }
+    }
+    if (!ex.ok) return "malformed Example record";
+    for (size_t i = 0; i < cfg.fields.size(); i++)
+      if (!found[i])
+        return "feature '" + cfg.fields[i].name + "' missing from record";
+    return "";
+  }
+
+  std::string extract_field(const FieldSpec& f, Cursor value, Slot& slot,
+                            int row) {
+    // value is a Feature message: 1=BytesList, 2=FloatList, 3=Int64List.
+    uint32_t wt;
+    while (uint32_t fnum = value.tag(&wt)) {
+      if (wt != 2) {
+        value.skip(wt);
+        continue;
+      }
+      Cursor list = value.bytes();
+      switch (fnum) {
+        case 1: {  // BytesList
+          if (f.kind != kImageFull && f.kind != kImageCoef)
+            return "feature '" + f.name + "' is bytes but spec is numeric";
+          // First bytes element is the payload.
+          uint32_t wt2;
+          while (uint32_t f2 = list.tag(&wt2)) {
+            if (f2 == 1 && wt2 == 2) {
+              Cursor payload = list.bytes();
+              if (f.kind == kImageFull) {
+                uint8_t* out = slot.buffers[f.buf0] +
+                               (size_t)row * f.h * f.w * f.c;
+                return decode_jpeg_full(payload.p, payload.size(), f, out);
+              }
+              long long yb = (long long)(f.h / 8) * (f.w / 8) * 64;
+              long long cb_n = (long long)(f.h / 16) * (f.w / 16) * 64;
+              return decode_jpeg_coef(
+                  payload.p, payload.size(), f,
+                  (int16_t*)slot.buffers[f.buf0] + (long long)row * yb,
+                  (int16_t*)slot.buffers[f.buf_cb] + (long long)row * cb_n,
+                  (int16_t*)slot.buffers[f.buf_cr] + (long long)row * cb_n,
+                  (uint16_t*)slot.buffers[f.buf_qt] + (long long)row * 3 * 64);
+            }
+            list.skip(wt2);
+          }
+          return "empty bytes list for '" + f.name + "'";
+        }
+        case 2: {  // FloatList
+          if (f.kind != kFloat)
+            return "feature '" + f.name + "' is float but spec is not";
+          float* out = (float*)slot.buffers[f.buf0] + (long long)row * f.count;
+          long long got = 0;
+          uint32_t wt2;
+          // Packed encoding: field 1 wiretype 2 (bulk) or repeated wiretype 5.
+          while (uint32_t f2 = list.tag(&wt2)) {
+            if (f2 == 1 && wt2 == 2) {
+              Cursor packed = list.bytes();
+              long long n = packed.size() / 4;
+              if (got + n > f.count)
+                return "too many floats for '" + f.name + "'";
+              memcpy(out + got, packed.p, n * 4);
+              got += n;
+            } else if (f2 == 1 && wt2 == 5) {
+              if (got >= f.count)
+                return "too many floats for '" + f.name + "'";
+              if (list.end - list.p < 4)
+                return "truncated float in '" + f.name + "'";
+              memcpy(out + got, list.p, 4);
+              list.p += 4;
+              got++;
+            } else {
+              list.skip(wt2);
+            }
+          }
+          if (got != f.count) {
+            char buf[128];
+            snprintf(buf, sizeof buf, "feature '%s': got %lld floats, want "
+                     "%lld", f.name.c_str(), got, f.count);
+            return buf;
+          }
+          return "";
+        }
+        case 3: {  // Int64List
+          if (f.kind != kInt)
+            return "feature '" + f.name + "' is int64 but spec is not";
+          uint8_t* base = slot.buffers[f.buf0] +
+                          (long long)row * f.count * f.dtype_size;
+          long long got = 0;
+          uint32_t wt2;
+          auto store = [&](uint64_t v) {
+            switch (f.dtype_size) {
+              case 1: base[got] = (uint8_t)v; break;
+              case 4: ((int32_t*)base)[got] = (int32_t)v; break;
+              default: ((int64_t*)base)[got] = (int64_t)v; break;
+            }
+            got++;
+          };
+          while (uint32_t f2 = list.tag(&wt2)) {
+            if (f2 == 1 && wt2 == 2) {
+              Cursor packed = list.bytes();
+              while (packed.p < packed.end && got < f.count)
+                store(packed.varint());
+              if (packed.p < packed.end)
+                return "too many ints for '" + f.name + "'";
+            } else if (f2 == 1 && wt2 == 0) {
+              if (got >= f.count)
+                return "too many ints for '" + f.name + "'";
+              store(list.varint());
+            } else {
+              list.skip(wt2);
+            }
+          }
+          if (got != f.count) {
+            char buf[128];
+            snprintf(buf, sizeof buf, "feature '%s': got %lld ints, want "
+                     "%lld", f.name.c_str(), got, f.count);
+            return buf;
+          }
+          return "";
+        }
+        default:
+          value.skip(wt);
+      }
+    }
+    return "feature '" + f.name + "' has no value list";
+  }
+
+  void worker_main() {
+    for (;;) {
+      WorkItem item;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stop.load() || !work.empty(); });
+        if (stop.load()) return;
+        if (work.empty()) continue;
+        item = std::move(work.front());
+        work.pop_front();
+      }
+      cv_space.notify_one();
+      std::string err = parse_into(item.record, item.slot, item.row);
+      if (!err.empty()) {
+        fail(err);
+        return;
+      }
+      Slot& slot = slots[item.slot];
+      if (slot.remaining.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(mu);
+        if (slot.seq == -2) {  // discarded partial batch at EOF
+          slot.state = kFree;
+          cv_free.notify_one();
+          cv_ready.notify_all();  // consumer may be waiting on the EOF check
+        } else {
+          slot.state = kReady;
+          // Insert in seq order so batches come out deterministically.
+          auto it = ready.begin();
+          while (it != ready.end() && slots[*it].seq < slot.seq) ++it;
+          ready.insert(it, item.slot);
+          completed_batches++;
+          cv_ready.notify_all();
+        }
+      }
+    }
+  }
+
+  // ---- consumer API ------------------------------------------------------
+
+  int next_slot() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_ready.wait(lk, [&] {
+      if (!error.empty()) return true;
+      // Deliver strictly in dispatch order: batch assembly is deterministic
+      // (single reader assigns rows in stream order), so ordered delivery
+      // makes the whole pipeline reproducible under a fixed seed even
+      // though decode is parallel.
+      if (!ready.empty() && slots[ready.front()].seq == next_seq_out)
+        return true;
+      if (eof && next_seq_out >= dispatched_batches) return true;
+      return false;
+    });
+    if (!error.empty()) return -2;
+    if (ready.empty() || slots[ready.front()].seq != next_seq_out)
+      return -1;  // end of data
+    int slot = ready.front();
+    ready.pop_front();
+    slots[slot].state = kInUse;
+    next_seq_out++;
+    return slot;
+  }
+
+  void release(int slot) {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (slot < 0 || slot >= (int)slots.size()) return;
+      slots[slot].state = kFree;
+    }
+    cv_free.notify_one();
+  }
+
+  bool start(std::string* err) {
+    slots.resize(cfg.ring);
+    for (auto& s : slots) {
+      for (long long sz : cfg.buffer_sizes) {
+        void* p = nullptr;
+        if (posix_memalign(&p, 64, (size_t)sz) != 0) {
+          *err = "allocation failed";
+          return false;
+        }
+        s.buffers.push_back((uint8_t*)p);
+      }
+    }
+    reader = std::thread([this] { reader_main(); });
+    for (int i = 0; i < cfg.threads; i++)
+      threads.emplace_back([this] { worker_main(); });
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* t2r_loader_create(const char* config, int config_len) {
+  auto* loader = new Loader();
+  std::string err;
+  if (!parse_config(std::string(config, config_len), &loader->cfg, &err) ||
+      !loader->start(&err)) {
+    loader->error = err.empty() ? "config error" : err;
+    loader->stop = true;
+    return loader;  // caller must check last_error
+  }
+  return loader;
+}
+
+const char* t2r_loader_last_error(void* h) {
+  auto* loader = (Loader*)h;
+  std::lock_guard<std::mutex> lk(loader->mu);
+  return loader->error.c_str();
+}
+
+int t2r_loader_num_buffers(void* h) {
+  return (int)((Loader*)h)->cfg.buffer_sizes.size();
+}
+
+long long t2r_loader_buffer_size(void* h, int buf) {
+  auto* loader = (Loader*)h;
+  if (buf < 0 || buf >= (int)loader->cfg.buffer_sizes.size()) return -1;
+  return loader->cfg.buffer_sizes[buf];
+}
+
+void* t2r_loader_buffer_ptr(void* h, int slot, int buf) {
+  auto* loader = (Loader*)h;
+  if (slot < 0 || slot >= (int)loader->slots.size()) return nullptr;
+  if (buf < 0 || buf >= (int)loader->slots[slot].buffers.size())
+    return nullptr;
+  return loader->slots[slot].buffers[buf];
+}
+
+int t2r_loader_ring_size(void* h) { return (int)((Loader*)h)->slots.size(); }
+
+int t2r_loader_next(void* h) { return ((Loader*)h)->next_slot(); }
+
+void t2r_loader_release(void* h, int slot) { ((Loader*)h)->release(slot); }
+
+void t2r_loader_destroy(void* h) { delete (Loader*)h; }
+
+}  // extern "C"

@@ -1,0 +1,372 @@
+"""ctypes front-end for the native C++ record loader.
+
+The C++ side (``data/native/record_loader.cc``) is the framework's native
+data-loader runtime: TFRecord framing, tf.Example wire parsing, libjpeg
+decode and batch assembly on a worker thread pool, with batches landing in a
+ring of preallocated buffers. This module:
+
+  * builds the shared library on first use (g++, cached by mtime);
+  * decides, from a feature/label spec pair, whether the fast path supports
+    the dataset (``plan_for_specs``) — exotic specs (sequences, varlen,
+    optional tensors, multi-dataset zip, PNG) fall back to the pure-Python
+    :class:`~tensor2robot_tpu.data.parser.ExampleParser` pipeline;
+  * exposes :class:`NativeBatchedStream`, an iterator of ``(features,
+    labels)`` SpecStruct batches matching BatchedExampleStream's contract.
+
+Parity target: the reference's input hot path is TF's C++ tf.data runtime
+(/root/reference/utils/tfdata.py:527-575 — parallel_interleave + map with
+num_parallel_calls + prefetch(AUTOTUNE)); this is the equivalent component,
+sized to host cores via the ``threads`` knob.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec, bfloat16
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), 'native')
+_SOURCE = os.path.join(_NATIVE_DIR, 'record_loader.cc')
+_BUILD_LOCK = threading.Lock()
+_LIB = None
+
+# Field kinds, mirroring record_loader.cc's FieldKind.
+_KIND_FLOAT = 0
+_KIND_INT = 1
+_KIND_IMAGE_FULL = 2
+_KIND_IMAGE_COEF = 3
+
+
+def _so_path() -> str:
+  return os.path.join(_NATIVE_DIR, '_record_loader.so')
+
+
+def build_native(force: bool = False) -> str:
+  """Compiles record_loader.cc into a shared library (cached by mtime)."""
+  so = _so_path()
+  with _BUILD_LOCK:
+    if (not force and os.path.exists(so)
+        and os.path.getmtime(so) >= os.path.getmtime(_SOURCE)):
+      return so
+    tmp = so + '.build.{}'.format(os.getpid())
+    cmd = ['g++', '-O2', '-fPIC', '-shared', '-std=c++17', '-msse4.2',
+           '-o', tmp, _SOURCE, '-ljpeg', '-lpthread']
+    try:
+      subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+      raise RuntimeError(
+          'native loader build failed:\n{}'.format(e.stderr)) from e
+    os.replace(tmp, so)  # atomic: concurrent builders race benignly
+  return so
+
+
+def _lib():
+  global _LIB
+  if _LIB is None:
+    lib = ctypes.CDLL(build_native())
+    lib.t2r_loader_create.restype = ctypes.c_void_p
+    lib.t2r_loader_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.t2r_loader_last_error.restype = ctypes.c_char_p
+    lib.t2r_loader_last_error.argtypes = [ctypes.c_void_p]
+    lib.t2r_loader_num_buffers.restype = ctypes.c_int
+    lib.t2r_loader_num_buffers.argtypes = [ctypes.c_void_p]
+    lib.t2r_loader_buffer_size.restype = ctypes.c_longlong
+    lib.t2r_loader_buffer_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.t2r_loader_buffer_ptr.restype = ctypes.c_void_p
+    lib.t2r_loader_buffer_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_int]
+    lib.t2r_loader_ring_size.restype = ctypes.c_int
+    lib.t2r_loader_ring_size.argtypes = [ctypes.c_void_p]
+    lib.t2r_loader_next.restype = ctypes.c_int
+    lib.t2r_loader_next.argtypes = [ctypes.c_void_p]
+    lib.t2r_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.t2r_loader_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+  return _LIB
+
+
+class _Field:
+  """One parsed field: config line + numpy view metadata."""
+
+  def __init__(self, key: str, spec: TensorSpec, kind: int,
+               dtype_size: int, shape: Tuple[int, ...],
+               view_dtype, count: int = 0):
+    self.key = key            # flat spec key ('state/image')
+    self.spec = spec
+    self.kind = kind
+    self.dtype_size = dtype_size
+    self.shape = shape        # per-row output shape
+    self.view_dtype = view_dtype
+    self.count = count
+    h, w, c = (shape + (0, 0, 0))[:3] if kind in (
+        _KIND_IMAGE_FULL, _KIND_IMAGE_COEF) else (0, 0, 0)
+    self.h, self.w, self.c = h, w, c
+
+  def config_line(self) -> str:
+    name = self.spec.name.encode('utf-8')
+    return '{} {} {} {} {} {} {} {}'.format(
+        len(name), self.kind, self.dtype_size, self.h, self.w, self.c,
+        self.count, self.spec.name)
+
+
+class NativeLoaderPlan:
+  """Eligibility + field layout for a (feature_spec, label_spec) pair."""
+
+  def __init__(self, fields: List[_Field], feature_spec, label_spec):
+    self.fields = fields
+    self.feature_spec = feature_spec
+    self.label_spec = label_spec
+
+
+def plan_for_specs(feature_spec, label_spec,
+                   image_mode: str = 'full') -> Optional[NativeLoaderPlan]:
+  """Returns a plan if the native fast path supports these specs, else None.
+
+  ``image_mode``: 'full' (decode to uint8 pixels) or 'coef' (entropy-only
+  decode; device finishes via data/jpeg_device.py — requires 4:2:0 JPEGs
+  with dims divisible by 16).
+  """
+  feature_spec = specs_lib.flatten_spec_structure(feature_spec)
+  label_spec = specs_lib.flatten_spec_structure(label_spec)
+  fields: List[_Field] = []
+  seen_names = set()
+  for side, struct in (('features', feature_spec), ('labels', label_spec)):
+    for key in struct:
+      spec = struct[key]
+      if spec.name is None or spec.name in seen_names:
+        # The Python parser supports unnamed specs (skipped) and the same
+        # on-disk feature bound under several spec keys (fanned out at pack
+        # time, parser.py _pack_side); the native pack stage does neither,
+        # and validate_and_pack would then raise on the missing keys every
+        # batch. Fall back rather than fail downstream.
+        return None
+      if (spec.is_optional or spec.is_sequence
+          or spec.varlen_default_value is not None
+          or (spec.dataset_key or '')):
+        return None
+      shape = tuple(spec.shape or ())
+      if any(s is None for s in shape):
+        return None
+      full_key = side + '/' + key
+      if spec.is_encoded_image:
+        if spec.data_format not in (None, 'jpeg', 'JPEG', 'jpg'):
+          return None
+        if len(shape) != 3 or spec.dtype != np.uint8 or shape[-1] not in (
+            1, 3):
+          return None
+        if image_mode == 'coef':
+          if shape[0] % 16 or shape[1] % 16 or shape[-1] != 3:
+            return None
+          fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
+                               np.int16))
+        else:
+          fields.append(_Field(full_key, spec, _KIND_IMAGE_FULL, 1, shape,
+                               np.uint8))
+      elif spec.dtype == np.dtype(object):
+        return None
+      elif spec.dtype in (np.float32, bfloat16):
+        count = int(np.prod(shape)) if shape else 1
+        fields.append(_Field(full_key, spec, _KIND_FLOAT, 4, shape,
+                             np.float32, count))
+      elif spec.dtype in (np.int64, np.int32, np.uint8, np.bool_):
+        size = {np.dtype(np.int64): 8, np.dtype(np.int32): 4,
+                np.dtype(np.uint8): 1, np.dtype(np.bool_): 1}[
+                    np.dtype(spec.dtype)]
+        count = int(np.prod(shape)) if shape else 1
+        fields.append(_Field(full_key, spec, _KIND_INT, size, shape,
+                             spec.dtype, count))
+      else:
+        return None
+      seen_names.add(spec.name)
+  if not fields:
+    return None
+  return NativeLoaderPlan(fields, feature_spec, label_spec)
+
+
+class NativeBatchedStream:
+  """Iterator of (features, labels) batches from the native loader.
+
+  Matches BatchedExampleStream's contract (data/pipeline.py:129). With
+  ``copy=False`` the yielded arrays are zero-copy views into the loader's
+  ring buffers, valid until ``ring - 1`` further batches have been drawn;
+  the default ``copy=True`` hands out owned arrays.
+  """
+
+  def __init__(self, plan: NativeLoaderPlan,
+               filenames: Sequence[str],
+               batch_size: int,
+               shuffle: bool = False,
+               shuffle_buffer: int = 500,
+               num_epochs: Optional[int] = None,
+               seed: Optional[int] = None,
+               num_threads: Optional[int] = None,
+               ring: int = 3,
+               verify_crc: bool = False,
+               copy: bool = True,
+               validate: bool = True):
+    self._plan = plan
+    self._batch_size = int(batch_size)
+    self._copy = copy
+    self._validate = validate
+    self._lib = _lib()
+    threads = num_threads or max(1, min(16, (os.cpu_count() or 2)))
+    lines = [
+        'batch_size {}'.format(self._batch_size),
+        'ring {}'.format(ring),
+        'threads {}'.format(threads),
+        'shuffle {}'.format(1 if shuffle else 0),
+        'shuffle_buffer {}'.format(shuffle_buffer),
+        'seed {}'.format(-1 if seed is None else seed),
+        'epochs {}'.format(-1 if num_epochs is None else num_epochs),
+        'verify_crc {}'.format(1 if verify_crc else 0),
+        'files {}'.format(len(filenames)),
+    ]
+    lines.extend(filenames)
+    lines.append('fields {}'.format(len(plan.fields)))
+    lines.extend(f.config_line() for f in plan.fields)
+    config = '\n'.join(lines).encode('utf-8')
+    self._handle = self._lib.t2r_loader_create(config, len(config))
+    if not self._handle:
+      raise RuntimeError('native loader creation failed')
+    err = self._lib.t2r_loader_last_error(self._handle)
+    if err:
+      msg = err.decode('utf-8', 'replace')
+      self._lib.t2r_loader_destroy(self._handle)
+      self._handle = None
+      raise RuntimeError('native loader: ' + msg)
+    self._ring = self._lib.t2r_loader_ring_size(self._handle)
+    self._views = self._build_views()
+    self._held_slot = -1
+    self._closed = False
+
+  # -- buffer views ----------------------------------------------------------
+
+  def _buffer_layout(self):
+    """(field, sub) per buffer index — mirrors record_loader.cc's order."""
+    layout = []
+    for f in self._plan.fields:
+      if f.kind == _KIND_IMAGE_COEF:
+        layout.extend([(f, 'y'), (f, 'cb'), (f, 'cr'), (f, 'qt')])
+      else:
+        layout.append((f, ''))
+    return layout
+
+  def _build_views(self):
+    layout = self._buffer_layout()
+    n_bufs = self._lib.t2r_loader_num_buffers(self._handle)
+    if n_bufs != len(layout):
+      raise RuntimeError('buffer layout mismatch: {} vs {}'.format(
+          n_bufs, len(layout)))
+    views = []
+    B = self._batch_size
+    for slot in range(self._ring):
+      slot_views = []
+      for buf, (f, sub) in enumerate(layout):
+        ptr = self._lib.t2r_loader_buffer_ptr(self._handle, slot, buf)
+        size = self._lib.t2r_loader_buffer_size(self._handle, buf)
+        if sub == '':
+          if f.kind == _KIND_IMAGE_FULL:
+            shape = (B,) + f.shape
+            dtype = np.uint8
+          else:
+            shape = (B,) + f.shape
+            dtype = f.view_dtype
+        elif sub == 'y':
+          shape = (B, f.h // 8, f.w // 8, 64)
+          dtype = np.int16
+        elif sub in ('cb', 'cr'):
+          shape = (B, f.h // 16, f.w // 16, 64)
+          dtype = np.int16
+        else:  # qt
+          shape = (B, 3, 64)
+          dtype = np.uint16
+        expect = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if expect != size:
+          raise RuntimeError(
+              'buffer {} size {} != expected {}'.format(buf, size, expect))
+        arr = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(size,)).view(dtype).reshape(shape)
+        slot_views.append(arr)
+      views.append(slot_views)
+    return views
+
+  # -- iteration -------------------------------------------------------------
+
+  def _pack(self, slot: int):
+    layout = self._buffer_layout()
+    by_key: Dict[str, np.ndarray] = {}
+    for buf, (f, sub) in enumerate(layout):
+      arr = self._views[slot][buf]
+      if self._copy:
+        arr = arr.copy()
+      key = f.key if not sub else f.key + '/' + sub
+      if sub == '' and f.spec.dtype == bfloat16:
+        arr = arr.astype(bfloat16)
+      by_key[key] = arr
+    features = SpecStruct()
+    labels = SpecStruct()
+    for key, arr in by_key.items():
+      side, rest = key.split('/', 1)
+      (features if side == 'features' else labels)[rest] = arr
+    if self._validate:
+      coef = any(f.kind == _KIND_IMAGE_COEF for f in self._plan.fields)
+      if not coef:  # coef outputs intentionally mismatch the image specs
+        features = specs_lib.validate_and_pack(
+            self._plan.feature_spec, features, ignore_batch=True)
+        if len(self._plan.label_spec):
+          labels = specs_lib.validate_and_pack(
+              self._plan.label_spec, labels, ignore_batch=True)
+    return features, labels
+
+  def __iter__(self):
+    while True:
+      slot = self._lib.t2r_loader_next(self._handle)
+      if slot == -1:
+        self._release_held()
+        return
+      if slot < 0:
+        err = self._lib.t2r_loader_last_error(self._handle)
+        raise RuntimeError('native loader: ' +
+                           (err or b'?').decode('utf-8', 'replace'))
+      try:
+        batch = self._pack(slot)
+      finally:
+        if self._copy:
+          self._lib.t2r_loader_release(self._handle, slot)
+        else:
+          # Zero-copy: hold this slot until the NEXT batch is drawn so the
+          # consumer can use the views for one full step.
+          self._release_held()
+          self._held_slot = slot
+      yield batch
+
+  def _release_held(self):
+    if self._held_slot >= 0:
+      self._lib.t2r_loader_release(self._handle, self._held_slot)
+      self._held_slot = -1
+
+  def close(self):
+    if not self._closed and self._handle:
+      self._closed = True
+      self._lib.t2r_loader_destroy(self._handle)
+      self._handle = None
+
+  def __del__(self):
+    try:
+      self.close()
+    except Exception:  # pragma: no cover - interpreter teardown
+      pass
+
+
+def native_loader_enabled() -> bool:
+  """Env switch: T2R_NATIVE_LOADER=0 disables the fast path."""
+  return os.environ.get('T2R_NATIVE_LOADER', '1') not in ('0', 'false', '')

@@ -1,0 +1,316 @@
+"""Tests for the native C++ record loader (data/native/record_loader.cc).
+
+Strategy: the pure-Python ExampleParser pipeline is the semantic oracle —
+the native path must produce byte-identical batches on the same records
+(both decode through libjpeg-turbo, so even JPEG pixels match exactly).
+"""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data import tfrecord
+from tensor2robot_tpu.data.input_generators import DefaultRecordInputGenerator
+from tensor2robot_tpu.data.parser import ExampleParser, build_example_for_specs
+from tensor2robot_tpu.data.wire import build_example
+from tensor2robot_tpu.data import native_loader
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec, bfloat16
+from tensor2robot_tpu.utils.image import numpy_to_image_string
+
+
+def _specs():
+  features = SpecStruct(
+      image=TensorSpec((48, 64, 3), np.uint8, name='img/encoded',
+                       data_format='jpeg'),
+      vec=TensorSpec((3,), np.float32, name='vec'),
+      scalar=TensorSpec((1,), np.float32, name='scalar'),
+      idx=TensorSpec((2,), np.int64, name='idx'),
+  )
+  labels = SpecStruct(
+      target=TensorSpec((1,), np.float32, name='target'))
+  return features, labels
+
+
+def _write_records(path, n, seed=0):
+  rng = np.random.RandomState(seed)
+  records = []
+  raw = []
+  for i in range(n):
+    img = rng.randint(0, 255, (48, 64, 3), dtype=np.uint8)
+    example = {
+        'img/encoded': numpy_to_image_string(img),
+        'vec': rng.rand(3).astype(np.float32),
+        'scalar': np.asarray([i], np.float32),
+        'idx': np.asarray([i, i * 2], np.int64),
+        'target': np.asarray([i * 0.5], np.float32),
+    }
+    raw.append(example)
+    records.append(build_example(example))
+  tfrecord.write_records(path, records)
+  return records, raw
+
+
+@pytest.fixture(scope='module')
+def record_file(tmp_path_factory):
+  path = str(tmp_path_factory.mktemp('native') / 'data.tfrecord')
+  records, raw = _write_records(path, 10)
+  return path, records, raw
+
+
+class TestPlan:
+
+  def test_eligible(self):
+    features, labels = _specs()
+    assert native_loader.plan_for_specs(features, labels) is not None
+
+  def test_sequence_ineligible(self):
+    features, labels = _specs()
+    features.seq = TensorSpec((4,), np.float32, name='seq', is_sequence=True)
+    assert native_loader.plan_for_specs(features, labels) is None
+
+  def test_optional_ineligible(self):
+    features, labels = _specs()
+    features.opt = TensorSpec((4,), np.float32, name='opt', is_optional=True)
+    assert native_loader.plan_for_specs(features, labels) is None
+
+  def test_png_ineligible(self):
+    features, labels = _specs()
+    features.image = TensorSpec((48, 64, 3), np.uint8, name='img/encoded',
+                                data_format='png')
+    assert native_loader.plan_for_specs(features, labels) is None
+
+  def test_varlen_ineligible(self):
+    features, labels = _specs()
+    features.v = TensorSpec((4,), np.float32, name='v',
+                            varlen_default_value=0.0)
+    assert native_loader.plan_for_specs(features, labels) is None
+
+  def test_coef_requires_mcu_aligned_dims(self):
+    features, labels = _specs()
+    plan = native_loader.plan_for_specs(features, labels, image_mode='coef')
+    assert plan is not None  # 48x64 is 16-aligned
+    features.image = TensorSpec((40, 64, 3), np.uint8, name='img/encoded',
+                                data_format='jpeg')
+    assert native_loader.plan_for_specs(
+        features, labels, image_mode='coef') is None
+
+
+class TestNativeStream:
+
+  def _native_batches(self, path, batch_size, **kwargs):
+    features, labels = _specs()
+    plan = native_loader.plan_for_specs(features, labels)
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=batch_size, **kwargs)
+    try:
+      return list(stream)
+    finally:
+      stream.close()
+
+  def test_matches_python_parser(self, record_file):
+    path, records, _ = record_file
+    features_spec, labels_spec = _specs()
+    batches = self._native_batches(path, 4, num_epochs=1)
+    assert len(batches) == 2  # 10 records, batch 4, remainder dropped
+    parser = ExampleParser(features_spec, labels_spec)
+    for i, (feats, labs) in enumerate(batches):
+      ref_feats, ref_labs = parser.parse_batch(records[i * 4:(i + 1) * 4])
+      for key in ref_feats:
+        np.testing.assert_array_equal(
+            np.asarray(feats[key]), np.asarray(ref_feats[key]), err_msg=key)
+      for key in ref_labs:
+        np.testing.assert_array_equal(
+            np.asarray(labs[key]), np.asarray(ref_labs[key]), err_msg=key)
+
+  def test_epochs(self, record_file):
+    path, _, _ = record_file
+    assert len(self._native_batches(path, 4, num_epochs=2)) == 5
+
+  def test_shuffle_reproducible(self, record_file):
+    path, _, _ = record_file
+    a = self._native_batches(path, 4, num_epochs=1, shuffle=True, seed=7,
+                             shuffle_buffer=8)
+    b = self._native_batches(path, 4, num_epochs=1, shuffle=True, seed=7,
+                             shuffle_buffer=8)
+    c = self._native_batches(path, 4, num_epochs=1)
+    for (fa, _), (fb, _) in zip(a, b):
+      np.testing.assert_array_equal(fa['scalar'], fb['scalar'])
+    assert not all(
+        np.array_equal(fa['scalar'], fc['scalar'])
+        for (fa, _), (fc, _) in zip(a, c))
+
+  def test_zero_copy_views_valid_for_one_step(self, record_file):
+    path, _, _ = record_file
+    features, labels = _specs()
+    plan = native_loader.plan_for_specs(features, labels)
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=2, num_epochs=1, copy=False)
+    try:
+      it = iter(stream)
+      feats, _ = next(it)
+      first = np.asarray(feats['scalar']).copy()
+      np.testing.assert_array_equal(first.ravel(), [0.0, 1.0])
+      next(it)  # previous views may now be recycled; copy was taken above
+    finally:
+      stream.close()
+
+  def test_missing_feature_raises(self, tmp_path):
+    path = str(tmp_path / 'bad.tfrecord')
+    tfrecord.write_records(
+        path, [build_example({'vec': np.zeros(3, np.float32)})])
+    with pytest.raises(RuntimeError, match='missing'):
+      self._native_batches(path, 1, num_epochs=1)
+
+  def test_wrong_image_dims_raises(self, tmp_path):
+    path = str(tmp_path / 'dims.tfrecord')
+    img = np.zeros((32, 32, 3), np.uint8)
+    tfrecord.write_records(path, [build_example({
+        'img/encoded': numpy_to_image_string(img),
+        'vec': np.zeros(3, np.float32),
+        'scalar': np.zeros(1, np.float32),
+        'idx': np.zeros(2, np.int64),
+        'target': np.zeros(1, np.float32),
+    })])
+    with pytest.raises(RuntimeError, match='dims'):
+      self._native_batches(path, 1, num_epochs=1)
+
+  def test_empty_image_is_zeros(self, tmp_path):
+    path = str(tmp_path / 'empty.tfrecord')
+    tfrecord.write_records(path, [build_example({
+        'img/encoded': b'',
+        'vec': np.zeros(3, np.float32),
+        'scalar': np.zeros(1, np.float32),
+        'idx': np.zeros(2, np.int64),
+        'target': np.zeros(1, np.float32),
+    })])
+    (feats, _), = self._native_batches(path, 1, num_epochs=1)
+    assert np.all(np.asarray(feats['image']) == 0)
+
+  def test_bfloat16_field(self, tmp_path):
+    path = str(tmp_path / 'bf16.tfrecord')
+    features = SpecStruct(x=TensorSpec((3,), bfloat16, name='x'))
+    tfrecord.write_records(path, [build_example(
+        {'x': np.asarray([1., 2., 3.], np.float32)})])
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=1, num_epochs=1)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    assert np.asarray(feats['x']).dtype == bfloat16
+
+
+class TestDeviceDecode:
+  """DCT-coefficient split decode: native coef mode + jpeg_device finish."""
+
+  def _coef_decode(self, jpeg_bytes, h, w):
+    from tensor2robot_tpu.data import jpeg_device
+    features = SpecStruct(image=TensorSpec((h, w, 3), np.uint8, name='im',
+                                           data_format='jpeg'))
+    plan = native_loader.plan_for_specs(features, SpecStruct(),
+                                        image_mode='coef')
+    import tempfile, os
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, 'coef.tfrecord')
+    tfrecord.write_records(path, [build_example({'im': jpeg_bytes})])
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=1, num_epochs=1, validate=False)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    return np.asarray(jpeg_device.decode_jpeg_coefficients(
+        np.asarray(feats['image/y']), np.asarray(feats['image/cb']),
+        np.asarray(feats['image/cr']), np.asarray(feats['image/qt'])))[0]
+
+  def test_matches_host_decode(self):
+    from tensor2robot_tpu.utils.image import image_string_to_numpy
+    rng = np.random.RandomState(0)
+    x = np.linspace(0, 1, 64)
+    yy = np.linspace(0, 1, 48)
+    img = (np.outer(yy, x)[..., None] * [220, 160, 90]).astype(np.float32)
+    img[10:30, 20:50] = [250, 30, 60]  # sharp chroma edge
+    img = np.clip(img + rng.randn(48, 64, 1) * 4, 0, 255).astype(np.uint8)
+    jpeg_bytes = numpy_to_image_string(img)
+    ref = image_string_to_numpy(jpeg_bytes)
+    out = self._coef_decode(jpeg_bytes, 48, 64)
+    diff = out.astype(int) - ref.astype(int)
+    # Float triangle upsample + float color convert vs libjpeg fixed-point:
+    # within +/-4 everywhere, sub-pixel on average.
+    assert np.abs(diff).max() <= 4
+    assert np.abs(diff).mean() < 0.6
+    assert (np.abs(diff) <= 1).mean() > 0.95
+
+  def test_decode_coef_features_helper(self):
+    from tensor2robot_tpu.data import jpeg_device
+    img = np.full((32, 32, 3), 128, np.uint8)
+    jpeg_bytes = numpy_to_image_string(img)
+    features = SpecStruct(image=TensorSpec((32, 32, 3), np.uint8, name='im',
+                                           data_format='jpeg'))
+    plan = native_loader.plan_for_specs(features, SpecStruct(),
+                                        image_mode='coef')
+    import tempfile, os
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, 'h.tfrecord')
+    tfrecord.write_records(path, [build_example({'im': jpeg_bytes})])
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=1, num_epochs=1, validate=False)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    out = jpeg_device.decode_coef_features(feats, ['image'])
+    assert 'image/y' not in out
+    arr = np.asarray(out['image'])
+    assert arr.shape == (1, 32, 32, 3)
+    assert np.abs(arr.astype(int) - 128).max() <= 4
+
+
+class TestGeneratorIntegration:
+
+  def test_record_generator_uses_native(self, record_file):
+    path, records, _ = record_file
+    features_spec, labels_spec = _specs()
+    gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=4)
+    gen.set_specification(features_spec, labels_spec)
+    native = gen._native_iterator(ModeKeys.EVAL, 1, 0, 1, None)
+    assert native is not None
+    parser = ExampleParser(features_spec, labels_spec)
+    ref_feats, _ = parser.parse_batch(records[:4])
+    feats, labs = next(native)
+    np.testing.assert_array_equal(
+        np.asarray(feats['image']), np.asarray(ref_feats['image']))
+    assert np.asarray(labs['target']).shape == (4, 1)
+
+  def test_generator_full_iteration(self, record_file):
+    path, _, _ = record_file
+    features_spec, labels_spec = _specs()
+    gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=4)
+    gen.set_specification(features_spec, labels_spec)
+    batches = list(gen.create_dataset_iterator(
+        mode=ModeKeys.TRAIN, num_epochs=2, seed=3))
+    assert len(batches) == 5
+    for feats, labs in batches:
+      assert np.asarray(feats['image']).shape == (4, 48, 64, 3)
+
+  def test_use_native_true_raises_on_unsupported(self, record_file):
+    path, _, _ = record_file
+    features_spec, labels_spec = _specs()
+    features_spec.seq = TensorSpec((4,), np.float32, name='s',
+                                   is_sequence=True)
+    gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=4,
+                                      use_native=True)
+    gen.set_specification(features_spec, labels_spec)
+    with pytest.raises(ValueError, match='not supported'):
+      gen._native_iterator(ModeKeys.TRAIN, 1, 0, 1, None)
+
+  def test_use_native_false(self, record_file):
+    path, _, _ = record_file
+    features_spec, labels_spec = _specs()
+    gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=4,
+                                      use_native=False)
+    gen.set_specification(features_spec, labels_spec)
+    assert gen._native_iterator(ModeKeys.TRAIN, 1, 0, 1, None) is None
