@@ -168,3 +168,8 @@ def register_all() -> None:
   register(replay_writer_module.TFRecordReplayWriter, 'TFRecordReplayWriter')
   register(episode_to_transitions.episode_to_transitions_pose_toy,
            'episode_to_transitions_pose_toy')
+
+  # Seq2Act transformer BC workload (RT-1-style, BASELINE config #5).
+  from tensor2robot_tpu.research import seq2act
+  register(seq2act.Seq2ActBCModel, 'Seq2ActBCModel')
+  register(seq2act.Seq2ActPreprocessor, 'Seq2ActPreprocessor')

@@ -80,10 +80,10 @@ def multi_batch_apply(fn: Callable, num_batch_dims: int, *args, **kwargs):
                       is_leaf=lambda x: hasattr(x, 'shape'))
 
 
-def _stack_struct(structs: Sequence[SpecStruct]) -> SpecStruct:
+def _stack_struct(structs: Sequence[SpecStruct], axis: int = 0) -> SpecStruct:
   out = SpecStruct()
   for key in structs[0]:
-    out[key] = np.stack([np.asarray(s[key]) for s in structs])
+    out[key] = np.stack([np.asarray(s[key]) for s in structs], axis=axis)
   return out
 
 

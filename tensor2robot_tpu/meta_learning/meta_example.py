@@ -1,0 +1,187 @@
+"""Meta-example records: task-grouped condition/inference episode bundles.
+
+Parity target: /root/reference/meta_learning/meta_example.py:34-72
+(make_meta_example / append_example / append_sequence_example). The
+reference merges per-episode tf.Examples into ONE record per task with
+prefixed feature names::
+
+    condition_ep0/<name>, condition_ep1/<name>, ...,
+    inference_ep0/<name>, ...
+
+which is how its meta-RL collect loop produces data the task-batched
+reader can consume. Here the merge happens at the wire-codec level (no TF
+proto objects): parse each episode record, re-emit with prefixed names.
+
+The read side is :class:`MetaExampleInputGenerator` — one RECORD == one
+task (complementing meta_data.MetaRecordInputGenerator's one FILE == one
+task layout) — producing the same [tasks, samples, ...] meta-batch layout
+the MAML models train on.
+
+The write side plugs into run_meta_env via ``write_meta_examples=True``:
+demo episodes become condition_ep*, trial episodes become inference_ep*,
+one meta record per task.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.data import wire
+from tensor2robot_tpu.data.parser import ExampleParser
+from tensor2robot_tpu.data.pipeline import parse_file_patterns
+from tensor2robot_tpu.data.tfrecord import read_all_records
+from tensor2robot_tpu.meta_learning import meta_data
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+CONDITION_PREFIX = 'condition_ep'
+INFERENCE_PREFIX = 'inference_ep'
+
+
+def _encodeable(feature_value):
+  """wire FeatureValue (kind, values) -> a value wire.build_* accepts."""
+  kind, values = feature_value
+  if kind == 'bytes':
+    return list(values)
+  if kind == 'float':
+    return np.asarray(values, np.float32)
+  return np.asarray(values, np.int64)
+
+
+def _is_sequence_example(serialized: bytes) -> bool:
+  """True if the record parses with a non-empty feature_lists side."""
+  try:
+    _, feature_lists = wire.parse_sequence_example(serialized)
+    return bool(feature_lists)
+  except Exception:  # noqa: BLE001 - malformed -> treat as plain Example
+    return False
+
+
+def make_meta_example(condition_examples: Sequence[bytes],
+                      inference_examples: Sequence[bytes]) -> bytes:
+  """Merges serialized episode Examples into one meta-example record.
+
+  Mirrors ref meta_example.py:34-50: feature names gain
+  ``condition_ep{i}/`` / ``inference_ep{i}/`` prefixes. SequenceExamples
+  merge both their context and their feature_lists sides (ref :62-72).
+  """
+  if not condition_examples or not inference_examples:
+    raise ValueError('Need at least one condition and one inference example.')
+  sequence = _is_sequence_example(condition_examples[0])
+  merged_context: Dict[str, object] = {}
+  merged_lists: Dict[str, list] = {}
+  for prefix, examples in ((CONDITION_PREFIX, condition_examples),
+                           (INFERENCE_PREFIX, inference_examples)):
+    for i, record in enumerate(examples):
+      tag = '{}{}/'.format(prefix, i)
+      if sequence:
+        context, feature_lists = wire.parse_sequence_example(record)
+        for name, value in context.items():
+          merged_context[tag + name] = _encodeable(value)
+        for name, steps in feature_lists.items():
+          merged_lists[tag + name] = [_encodeable(s) for s in steps]
+      else:
+        for name, value in wire.parse_example(record).items():
+          merged_context[tag + name] = _encodeable(value)
+  if sequence:
+    return wire.build_sequence_example(merged_context, merged_lists)
+  return wire.build_example(merged_context)
+
+
+def _prefixed_specs(feature_spec: SpecStruct, label_spec: SpecStruct,
+                    prefix: str):
+  """Copies of the base specs with on-disk names under ``prefix/``."""
+
+  def _rename(struct):
+    out = SpecStruct()
+    for key in struct:
+      spec = struct[key]
+      name = spec.name if spec.name is not None else key
+      out[key] = TensorSpec.from_spec(spec, name=prefix + '/' + name)
+    return out
+
+  return _rename(feature_spec), _rename(label_spec)
+
+
+class MetaExampleInputGenerator(meta_data.AbstractInputGenerator):
+  """Reads meta-example records: one RECORD == one task.
+
+  Yields the same meta-batch layout as MetaRecordInputGenerator
+  ([num_tasks, num_samples, ...] split into condition/inference by
+  meta_data.to_meta_batch), so MAML models and their preprocessors consume
+  both interchangeably.
+  """
+
+  def __init__(self,
+               file_patterns: str,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1,
+               num_tasks: Optional[int] = None,
+               shuffle: bool = True,
+               **kwargs):
+    kwargs.setdefault('batch_size', num_tasks or 2)
+    super().__init__(**kwargs)
+    self._file_patterns = file_patterns
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+    self._num_tasks = num_tasks or self._batch_size
+    self._shuffle = shuffle
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards, seed):
+    _, files = parse_file_patterns(self._file_patterns)
+    files = files[shard_index::num_shards]
+    if not files:
+      raise ValueError('No meta-example files match {}.'.format(
+          self._file_patterns))
+    feature_spec, label_spec = meta_data.split_meta_in_spec(
+        self._feature_spec)
+    parsers = []
+    for i in range(self._num_condition + self._num_inference):
+      prefix = (CONDITION_PREFIX + str(i) if i < self._num_condition
+                else INFERENCE_PREFIX + str(i - self._num_condition))
+      parsers.append(ExampleParser(
+          *_prefixed_specs(feature_spec, label_spec, prefix)))
+    rng = np.random.RandomState(seed)
+
+    def _parse_chunk(chunk):
+      sample_feats, sample_labels = [], []
+      for parser in parsers:  # one parse per sample slot
+        features, labels = parser.parse_batch(chunk)
+        sample_feats.append(features)
+        sample_labels.append(labels)
+      features = meta_data._stack_struct(sample_feats, axis=1)
+      labels = meta_data._stack_struct(sample_labels, axis=1)
+      return meta_data.to_meta_batch(features, labels, self._num_condition)
+
+    def _iter():
+      # Lazy, one file resident at a time: meta records bundle whole image
+      # episodes, so holding every matched file in RAM (and re-parsing all
+      # of it each epoch) does not scale to real collect runs.
+      epoch = 0
+      while num_epochs is None or epoch < num_epochs:
+        file_order = (rng.permutation(len(files)) if self._shuffle
+                      else np.arange(len(files)))
+        pending: List[bytes] = []
+        yielded = False
+        for file_idx in file_order:
+          records = read_all_records(files[file_idx])
+          rec_order = (rng.permutation(len(records)) if self._shuffle
+                       else np.arange(len(records)))
+          pending.extend(records[i] for i in rec_order)
+          while len(pending) >= self._num_tasks:
+            chunk, pending = (pending[:self._num_tasks],
+                              pending[self._num_tasks:])
+            yield _parse_chunk(chunk)
+            yielded = True
+        if not yielded:
+          # Fewer records than num_tasks: an infinite epoch loop would
+          # otherwise spin forever without producing a batch.
+          raise ValueError(
+              'Meta-example files {} hold fewer than num_tasks={} records; '
+              'collect more tasks or lower num_tasks.'.format(
+                  files, self._num_tasks))
+        epoch += 1
+
+    return _iter()

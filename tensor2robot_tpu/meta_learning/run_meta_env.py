@@ -38,9 +38,22 @@ def run_meta_env(env,
                  num_demos: int = 1,
                  break_after_one_task: bool = False,
                  tag: str = 'collect',
-                 write_summary: bool = False):
-  """See module docstring; args mirror the reference (:54-88)."""
+                 write_summary: bool = False,
+                 write_meta_examples: bool = False):
+  """See module docstring; args mirror the reference (:54-88).
+
+  ``write_meta_examples``: instead of writing per-episode transition
+  records, group each task's episodes into ONE meta-example record —
+  demos under condition_ep*, trials under inference_ep* (the reference's
+  make_meta_example contract, meta_example.py:34-72) — which
+  MetaExampleInputGenerator reads back for MAML training. Requires
+  ``episode_to_transitions_fn`` (its per-episode examples are the merge
+  inputs) plus ``replay_writer`` and ``root_dir``.
+  """
   del num_episodes  # ref :90 — num_tasks drives the loop
+  if write_meta_examples and episode_to_transitions_fn is None:
+    raise ValueError(
+        'write_meta_examples requires episode_to_transitions_fn.')
 
   task_step_rewards = collections.defaultdict(
       lambda: collections.defaultdict(list))
@@ -76,6 +89,7 @@ def run_meta_env(env,
       replay_writer.open(record_name)
 
     condition_data = []
+    condition_examples, inference_examples = [], []
     if demo_policy_cls is not None and hasattr(policy, 'adapt'):
       for _ in range(num_demos):
         episode_data = _run_demo_episode()
@@ -83,13 +97,19 @@ def run_meta_env(env,
         # Gated on record_name (not just the writer): without root_dir
         # the writer was never opened (matches rl/run_env.py:96-100).
         if record_name and episode_to_transitions_fn:
-          replay_writer.write(episode_to_transitions_fn(episode_data))
+          examples = episode_to_transitions_fn(episode_data)
+          if write_meta_examples:
+            condition_examples.extend(examples)
+          else:
+            replay_writer.write(examples)
       policy.adapt(copy.copy(condition_data))
     elif hasattr(env, 'task_data') and hasattr(policy, 'adapt'):
       # Record-backed envs carry their own conditioning episodes (ref :170).
       for episode_name, episode_data in env.task_data.items():
         if str(episode_name).startswith('condition_ep'):
           condition_data.append(episode_data)
+          if write_meta_examples and record_name:
+            condition_examples.extend(episode_to_transitions_fn(episode_data))
       policy.adapt(copy.copy(condition_data))
 
     for step_num in range(num_adaptations_per_task):
@@ -119,11 +139,30 @@ def run_meta_env(env,
                  episode_reward)
             task_step_rewards[task_idx][step_num].append(episode_reward)
             if record_name and episode_to_transitions_fn:
-              replay_writer.write(episode_to_transitions_fn(episode_data))
+              examples = episode_to_transitions_fn(episode_data)
+              if write_meta_examples:
+                inference_examples.extend(examples)
+              else:
+                replay_writer.write(examples)
         condition_data.append(episode_data)
     _log('Task %d avg reward: %f', task_idx,
          np.mean(task_step_rewards[task_idx][num_adaptations_per_task - 1]))
 
+    if write_meta_examples and record_name:
+      if not condition_examples or not inference_examples:
+        # Silently dropping the task would leave an empty record file the
+        # reader later rejects; fail with the actionable cause instead.
+        raise ValueError(
+            'write_meta_examples: task {} collected {} condition and {} '
+            'inference examples; both sides need at least one (provide a '
+            'demo_policy_cls or env.task_data conditioning episodes).'
+            .format(task_idx, len(condition_examples),
+                    len(inference_examples)))
+      from tensor2robot_tpu.meta_learning.meta_example import (
+          make_meta_example,
+      )
+      replay_writer.write(make_meta_example(condition_examples,
+                                            inference_examples))
     if replay_writer and record_name:
       replay_writer.close()
     if break_after_one_task:

@@ -74,6 +74,44 @@ def center_crop_images(images: List[jnp.ndarray],
   return [img[:, y0:y0 + th, x0:x0 + tw, :] for img in images]
 
 
+# -- episode ([B, T, H, W, C]) crops ------------------------------------------
+# One offset per EPISODE, shared across its time steps: a fixed camera does
+# not jitter within an episode (vrgripper preprocessor parity, ref
+# vrgripper_env_models.py:108-141).
+
+
+def random_crop_episodes(key: jax.Array, episodes: jnp.ndarray,
+                         target_shape: Tuple[int, int]) -> jnp.ndarray:
+  """Random crop of [B, T, H, W, C] with per-episode shared offsets."""
+  batch, _, height, width = episodes.shape[:4]
+  th, tw = target_shape
+  if th > height or tw > width:
+    raise ValueError('Crop {} exceeds image size {}.'.format(
+        target_shape, (height, width)))
+  ky, kx = jax.random.split(key)
+  ys = jax.random.randint(ky, (batch,), 0, height - th + 1)
+  xs = jax.random.randint(kx, (batch,), 0, width - tw + 1)
+
+  def _one(episode, y, x):
+    return jax.lax.dynamic_slice(
+        episode, (0, y, x, 0),
+        (episode.shape[0], th, tw, episode.shape[3]))
+
+  return jax.vmap(_one)(episodes, ys, xs)
+
+
+def center_crop_episodes(episodes: jnp.ndarray,
+                         target_shape: Tuple[int, int]) -> jnp.ndarray:
+  """Deterministic center crop of [B, T, H, W, C]."""
+  height, width = episodes.shape[2], episodes.shape[3]
+  th, tw = target_shape
+  if th > height or tw > width:
+    raise ValueError('Crop {} exceeds image size {}.'.format(
+        target_shape, (height, width)))
+  y0, x0 = (height - th) // 2, (width - tw) // 2
+  return episodes[:, :, y0:y0 + th, x0:x0 + tw, :]
+
+
 # -- photometric distortions -------------------------------------------------
 
 _RGB_TO_GRAY = jnp.asarray([0.299, 0.587, 0.114])

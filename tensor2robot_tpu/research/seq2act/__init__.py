@@ -1,0 +1,5 @@
+from tensor2robot_tpu.research.seq2act.seq2act_model import (
+    RT1StyleNet,
+    Seq2ActBCModel,
+    Seq2ActPreprocessor,
+)

@@ -1,0 +1,303 @@
+"""RT-1-style transformer behavior cloning: episodes of frames -> actions.
+
+BASELINE.json config #5 ("stretch T2RModel to seq-to-action") — the one
+workload family the reference never had. Its sequence models collapse each
+frame to one vector and run a TCN/attention hybrid over tiny windows
+(SNAIL, /root/reference/layers/snail.py:78); this model keeps K visual
+tokens per frame (conv stem + TokenLearner) and runs a causal transformer
+over the full episode's token sequence, with the attention backend scaling
+from dense XLA through the Pallas flash kernel to mesh-sharded ring
+attention for long-context episodes (layers/transformer.py).
+
+Actions are discretized per dimension into ``vocab_size`` bins and trained
+with cross-entropy (the RT-1 recipe; head shared with the vrgripper
+discrete decoder, research/vrgripper/decoders.py:107-139). Serving emits
+both the per-step action sequence and the final step's action for
+robot-time policies.
+
+Episode data layout follows the framework's episode convention
+(vrgripper_env_models.py): fixed ``episode_length`` leading time dim per
+example, frames stored as uint8 at source resolution, SequenceExample or
+fixed-shape Example records both parse into it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.layers import transformer as transformer_lib
+from tensor2robot_tpu.meta_learning.meta_data import multi_batch_apply
+from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+from tensor2robot_tpu.models import optimizers as opt_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.research.vrgripper import decoders
+from tensor2robot_tpu.specs import algebra
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+class Seq2ActPreprocessor(AbstractPreprocessor):
+  """uint8 episode frames at source res -> cropped float32 in [0, 1].
+
+  Train mode random-crops with one offset per episode (fixed camera; the
+  crop must not jitter within an episode — vrgripper preprocessor parity);
+  eval/predict center-crops. Runs inside the jitted step.
+  """
+
+  def __init__(self,
+               model_feature_specification_fn=None,
+               model_label_specification_fn=None,
+               src_img_res: Tuple[int, int] = (136, 168)):
+    super().__init__(model_feature_specification_fn,
+                     model_label_specification_fn)
+    self._src_img_res = tuple(src_img_res)
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    spec = algebra.flatten_spec_structure(
+        self._model_feature_specification(mode))
+    out = SpecStruct()
+    for key in spec:
+      if key == 'image' or key.endswith('/image'):
+        shape = list(spec[key].shape)
+        shape[-3:-1] = self._src_img_res
+        out[key] = TensorSpec.from_spec(spec[key], shape=tuple(shape),
+                                        dtype=np.uint8)
+      else:
+        out[key] = spec[key]
+    return out
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_label_specification(mode))
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_feature_specification(mode))
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return algebra.flatten_spec_structure(
+        self._model_label_specification(mode))
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None):
+    out_spec = self.get_out_feature_specification(mode)
+    # ONE crop key for every */image view: multi-camera views of the same
+    # episode must stay registered (the crop-alignment invariant of
+    # image_transformations.random_crop_images).
+    kcrop = None
+    if mode == ModeKeys.TRAIN and rng is not None:
+      kcrop = jax.random.split(jnp.asarray(rng))[1]
+    for key in features:
+      if not (key == 'image' or key.endswith('/image')):
+        continue
+      images = jnp.asarray(features[key])
+      squeeze = images.ndim == 4  # unbatched single episode
+      if squeeze:
+        images = images[None]
+      target_hw = tuple(out_spec[key].shape[-3:-1])
+      if target_hw != tuple(images.shape[2:4]):
+        if mode == ModeKeys.TRAIN:
+          if kcrop is None:
+            raise ValueError('TRAIN-mode preprocessing requires an rng key.')
+          images = image_transformations.random_crop_episodes(
+              kcrop, images, target_hw)
+        else:
+          images = image_transformations.center_crop_episodes(
+              images, target_hw)
+      images = jnp.asarray(images, jnp.float32) / 255.0
+      features[key] = images[0] if squeeze else images
+    return features, labels
+
+
+class RT1StyleNet(nn.Module):
+  """Tokenize frames -> causal transformer -> per-step binned action head."""
+
+  action_size: int
+  vocab_size: int
+  tokens_per_frame: int
+  embed_dim: int
+  num_layers: int
+  num_heads: int
+  head_dim: int
+  mlp_dim: int
+  max_episode_length: int
+  tokenizer_widths: tuple
+  attention_mode: str = 'auto'
+  mesh: Optional[object] = None
+  dropout_rate: float = 0.0
+  dtype: jnp.dtype = jnp.float32
+  use_state_input: bool = False
+
+  @nn.compact
+  def __call__(self, features, mode: str = ModeKeys.TRAIN,
+               train: bool = False):
+    images = jnp.asarray(features['image'], self.dtype)
+    b, t = images.shape[0], images.shape[1]
+
+    def _tokenize(frames):
+      return transformer_lib.ImageTokenizer(
+          num_tokens=self.tokens_per_frame, embed_dim=self.embed_dim,
+          widths=self.tokenizer_widths, dtype=self.dtype,
+          name='tokenizer')(frames, train=train)
+
+    tokens = multi_batch_apply(_tokenize, 2, images)    # [B, T, K, D]
+    k = tokens.shape[2]
+    if self.use_state_input:
+      state = jnp.asarray(features['state'], self.dtype)  # [B, T, S]
+      state_token = nn.Dense(self.embed_dim, dtype=self.dtype,
+                             name='state_token')(state)[:, :, None, :]
+      tokens = jnp.concatenate([tokens, state_token], axis=2)
+      k += 1
+    tokens = tokens.reshape(b, t * k, self.embed_dim)
+    encoded = transformer_lib.CausalTransformer(
+        num_layers=self.num_layers, num_heads=self.num_heads,
+        head_dim=self.head_dim, mlp_dim=self.mlp_dim,
+        max_length=self.max_episode_length * k,
+        attention_mode=self.attention_mode, mesh=self.mesh,
+        dropout_rate=self.dropout_rate, dtype=self.dtype,
+        name='transformer')(tokens, train=train)
+    # Last token of each frame: under the token-causal mask it has seen the
+    # whole frame plus all history — the natural readout position.
+    frame_out = encoded.reshape(b, t, k, -1)[:, :, -1, :]
+    logits = nn.Dense(self.action_size * self.vocab_size, name='action_head',
+                      dtype=jnp.float32)(frame_out)  # [B, T, A*V]
+    return SpecStruct(action_logits=logits)
+
+
+class Seq2ActBCModel(AbstractT2RModel):
+  """T2R contract around RT1StyleNet (see module docstring)."""
+
+  label_key = 'action'
+
+  def __init__(self,
+               episode_length: int = 6,
+               action_size: int = 7,
+               vocab_size: int = 256,
+               img_res: Tuple[int, int] = (128, 160),
+               src_img_res: Tuple[int, int] = (136, 168),
+               tokens_per_frame: int = 8,
+               embed_dim: int = 512,
+               num_layers: int = 8,
+               num_heads: int = 8,
+               head_dim: int = 64,
+               mlp_dim: int = 2048,
+               tokenizer_widths: Sequence[int] = (32, 64, 128, 256),
+               action_min: float = -1.0,
+               action_max: float = 1.0,
+               attention_mode: str = 'auto',
+               mesh: Optional[object] = None,
+               max_episode_length: Optional[int] = None,
+               dropout_rate: float = 0.0,
+               use_state_input: bool = False,
+               state_size: int = 7,
+               learning_rate: float = 1e-4,
+               **kwargs):
+    import functools
+    kwargs.setdefault('device_type', 'cpu')
+    kwargs.setdefault(
+        'create_optimizer_fn',
+        lambda: opt_lib.create_adam_optimizer(learning_rate=learning_rate))
+    super().__init__(
+        preprocessor_cls=functools.partial(Seq2ActPreprocessor,
+                                           src_img_res=tuple(src_img_res)),
+        **kwargs)
+    self._episode_length = episode_length
+    self._action_size = action_size
+    self._vocab_size = vocab_size
+    self._img_res = tuple(img_res)
+    self._src_img_res = tuple(src_img_res)
+    self._tokens_per_frame = tokens_per_frame
+    self._embed_dim = embed_dim
+    self._num_layers = num_layers
+    self._num_heads = num_heads
+    self._head_dim = head_dim
+    self._mlp_dim = mlp_dim
+    self._tokenizer_widths = tuple(tokenizer_widths)
+    self._action_min = action_min
+    self._action_max = action_max
+    self._attention_mode = attention_mode
+    self._mesh = mesh
+    self._max_episode_length = max_episode_length or episode_length
+    self._dropout_rate = dropout_rate
+    self._use_state_input = use_state_input
+    self._state_size = state_size
+    self._bin_centers = decoders.get_discrete_bins(
+        vocab_size, np.full((action_size,), action_min, np.float32),
+        np.full((action_size,), action_max, np.float32))
+
+  @property
+  def episode_length(self) -> int:
+    return self._episode_length
+
+  def get_feature_specification(self, mode: str) -> SpecStruct:
+    del mode
+    h, w = self._img_res
+    spec = SpecStruct(
+        image=TensorSpec((self._episode_length, h, w, 3), np.float32,
+                         name='image0', data_format='jpeg'))
+    if self._use_state_input:
+      spec['state'] = TensorSpec(
+          (self._episode_length, self._state_size), np.float32, name='state')
+    return spec
+
+  def get_label_specification(self, mode: str) -> SpecStruct:
+    del mode
+    return SpecStruct(action=TensorSpec(
+        (self._episode_length, self._action_size), np.float32, name='action'))
+
+  def create_network(self) -> nn.Module:
+    return RT1StyleNet(
+        action_size=self._action_size,
+        vocab_size=self._vocab_size,
+        tokens_per_frame=self._tokens_per_frame,
+        embed_dim=self._embed_dim,
+        num_layers=self._num_layers,
+        num_heads=self._num_heads,
+        head_dim=self._head_dim,
+        mlp_dim=self._mlp_dim,
+        max_episode_length=self._max_episode_length,
+        tokenizer_widths=self._tokenizer_widths,
+        attention_mode=self._attention_mode,
+        mesh=self._mesh,
+        dropout_rate=self._dropout_rate,
+        dtype=self.compute_dtype,
+        use_state_input=self._use_state_input)
+
+  def model_train_fn(self, variables, features, labels, inference_outputs,
+                     mode: str):
+    logits = inference_outputs['action_logits']  # [B, T, A*V]
+    actions = jnp.asarray(labels[self.label_key], jnp.float32)
+    loss = decoders.get_discrete_action_loss(
+        logits, actions, self._bin_centers, self._vocab_size)
+    predicted = decoders.get_discrete_actions(
+        logits, self._action_size, self._vocab_size, self._bin_centers)
+    bin_width = (self._action_max - self._action_min) / self._vocab_size
+    within_bin = jnp.abs(predicted - actions) <= (bin_width * 0.5 + 1e-6)
+    return loss, SpecStruct(loss=loss,
+                            action_accuracy=jnp.mean(
+                                within_bin.astype(jnp.float32)),
+                            action_mae=jnp.mean(jnp.abs(predicted - actions)))
+
+  def model_eval_fn(self, variables, features, labels, inference_outputs,
+                    mode: str) -> SpecStruct:
+    loss, metrics = self.model_train_fn(variables, features, labels,
+                                        inference_outputs, mode)
+    return metrics
+
+  def create_export_outputs_fn(self, features, inference_outputs, mode: str
+                               ) -> SpecStruct:
+    logits = inference_outputs['action_logits']
+    action = decoders.get_discrete_actions(
+        logits, self._action_size, self._vocab_size, self._bin_centers)
+    return SpecStruct(
+        action=action,                      # [B, T, A]
+        inference_output=action[:, -1, :],  # robot-time: newest step's action
+        action_logits=logits)

@@ -291,6 +291,135 @@ class TestMetaRecordInputGenerator:
       assert np.allclose(ratio, ratio[0, 0], atol=1e-5)
 
 
+class TestMetaExample:
+  """make_meta_example + MetaExampleInputGenerator close the meta-RL data
+  loop (VERDICT-r2 item 4; ref meta_learning/meta_example.py:34-72)."""
+
+  def test_make_and_read_back_linear_tasks(self, tmp_path):
+    from tensor2robot_tpu.data import wire
+    from tensor2robot_tpu.data.tfrecord import write_records
+    from tensor2robot_tpu.meta_learning.meta_example import (
+        MetaExampleInputGenerator,
+        make_meta_example,
+    )
+    rng = np.random.RandomState(0)
+    records = []
+    for task in range(4):
+      w = float(task + 1)
+
+      def _example():
+        x = rng.rand(1).astype(np.float32)
+        return wire.build_example({'x': x, 'target': (w * x).astype(
+            np.float32)})
+
+      records.append(make_meta_example(
+          [_example(), _example()], [_example(), _example()]))
+    write_records(str(tmp_path / 'meta.tfrecord'), records)
+
+    model = _maml_model()
+    generator = MetaExampleInputGenerator(
+        file_patterns=str(tmp_path / 'meta.tfrecord'),
+        num_condition_samples_per_task=2,
+        num_inference_samples_per_task=2, num_tasks=2, shuffle=False)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(
+        generator.create_dataset_iterator(mode=ModeKeys.TRAIN, seed=0))
+    assert features['condition/features/x'].shape == (2, 2, 1)
+    assert features['inference/features/x'].shape == (2, 2, 1)
+    assert labels['target'].shape == (2, 2, 1)
+    # Condition/inference samples of one meta record share the task's w.
+    for t in range(2):
+      cond = (features['condition/labels/target'][t] /
+              features['condition/features/x'][t])
+      inf = (labels['target'][t] / features['inference/features/x'][t])
+      assert np.allclose(cond, cond[0, 0], atol=1e-5)
+      assert np.allclose(inf, cond[0, 0], atol=1e-5)
+
+  def test_sequence_example_merge(self):
+    from tensor2robot_tpu.data import wire
+    from tensor2robot_tpu.meta_learning.meta_example import make_meta_example
+    seq = wire.build_sequence_example(
+        {'task_id': np.asarray([3], np.int64)},
+        {'obs': [np.asarray([1.0], np.float32),
+                 np.asarray([2.0], np.float32)]})
+    merged = make_meta_example([seq], [seq])
+    context, feature_lists = wire.parse_sequence_example(merged)
+    assert 'condition_ep0/task_id' in context
+    assert 'inference_ep0/obs' in feature_lists
+    kind, values = feature_lists['condition_ep0/obs'][1]
+    assert kind == 'float' and float(np.asarray(values)[0]) == 2.0
+
+  def test_collect_to_maml_train_round_trip(self, tmp_path):
+    """run_meta_env(write_meta_examples=True) writes N task records; MAML
+    trains one step straight from those files."""
+    import glob
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.data.writer import TFRecordReplayWriter
+    from tensor2robot_tpu.meta_learning import run_meta_env
+    from tensor2robot_tpu.meta_learning.meta_example import (
+        MetaExampleInputGenerator,
+    )
+    from tensor2robot_tpu.research.pose_env import PoseToyEnv
+    from tensor2robot_tpu.research.pose_env.episode_to_transitions import (
+        episode_to_transitions_pose_toy,
+    )
+    from tensor2robot_tpu.research.pose_env.pose_env_maml_models import (
+        PoseEnvRegressionModelMAML,
+    )
+
+    class _StubPolicy:
+      """Random actions; adapt() makes run_meta_env collect demos."""
+
+      def adapt(self, condition_data):
+        self.adapted = True
+
+      def reset(self):
+        pass
+
+      def sample_action(self, obs, explore_prob):
+        return np.asarray([0.1, -0.1], np.float32), None
+
+    class _DemoPolicy:
+
+      def __init__(self, env):
+        self._env = env
+        self._steps = 0
+
+      def sample_action(self, obs, explore_prob):
+        if self._steps >= 1:
+          return None, None
+        self._steps += 1
+        return self._env._target_pose[:2].astype(np.float32), None
+
+    root = str(tmp_path / 'meta_records')
+    env = PoseToyEnv(seed=3)
+    run_meta_env(
+        env, policy=_StubPolicy(), demo_policy_cls=_DemoPolicy,
+        episode_to_transitions_fn=episode_to_transitions_pose_toy,
+        replay_writer=TFRecordReplayWriter(), root_dir=root,
+        num_tasks=2, num_adaptations_per_task=1,
+        num_episodes_per_adaptation=2, num_demos=2,
+        write_meta_examples=True)
+    files = sorted(glob.glob(root + '/*'))
+    assert len(files) == 2  # one meta-example record file per task
+
+    model = PoseEnvRegressionModelMAML()
+    generator = MetaExampleInputGenerator(
+        file_patterns=root + '/*',
+        num_condition_samples_per_task=2,
+        num_inference_samples_per_task=2, num_tasks=2, shuffle=False)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer = Trainer(model, str(tmp_path / 'run'), async_checkpoints=False,
+                      mesh=parallel.create_mesh(
+                          {'data': 1}, devices=jax.devices()[:1]),
+                      save_checkpoints_steps=10**9)
+    try:
+      state = trainer.train(generator, max_train_steps=1)
+      assert int(jax.device_get(state.step)) == 1
+    finally:
+      trainer.close()
+
+
 class TestPoseEnvMAML:
 
   def test_pack_features_and_forward(self):

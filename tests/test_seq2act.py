@@ -1,0 +1,335 @@
+"""RT-1-style transformer BC workload tests (research/seq2act).
+
+Covers the transformer layer library (causality, flash-vs-dense parity,
+TokenLearner), model-level causality, a learning test on a synthetic
+imitation rule that REQUIRES temporal attention (the action at step t
+copies a visual cue from step t-2), export -> predictor parity, and ring
+attention on the 8-device mesh matching single-device numerics.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import parallel
+from tensor2robot_tpu.data.input_generators import (
+    DefaultRandomInputGenerator,
+    GeneratorInputGenerator,
+)
+from tensor2robot_tpu.layers import transformer as transformer_lib
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.predictors import CheckpointPredictor
+from tensor2robot_tpu.research import seq2act
+from tensor2robot_tpu.research.seq2act import Seq2ActBCModel
+from tensor2robot_tpu.trainer import Trainer
+
+# Tiny config for one-core CPU tests: 4 frames x 4 tokens = 16-token
+# sequences through a 2-layer transformer.
+TINY = dict(
+    episode_length=4,
+    action_size=2,
+    vocab_size=16,
+    img_res=(32, 32),
+    src_img_res=(36, 36),
+    tokens_per_frame=4,
+    embed_dim=32,
+    num_layers=2,
+    num_heads=2,
+    head_dim=8,
+    mlp_dim=64,
+    tokenizer_widths=(8, 16, 16, 32),
+    attention_mode='xla',
+)
+
+
+def _episode_batch(rng, batch_size, episode_length=4, img=36, action_size=2):
+  """Synthetic imitation rule requiring temporal attention.
+
+  Each frame is a uniform brightness v_t; the expert action is
+  [2*v_t - 1, 2*v_{t-2} - 1] — dimension 1 can ONLY be predicted by
+  attending two frames back.
+  """
+  v = rng.rand(batch_size, episode_length).astype(np.float32)
+  frames = np.broadcast_to(
+      (v * 255).astype(np.uint8)[:, :, None, None, None],
+      (batch_size, episode_length, img, img, 3)).copy()
+  shifted = np.concatenate([v[:, :1], v[:, :1], v[:, :-2]], axis=1)
+  action = np.stack([2 * v - 1, 2 * shifted - 1], axis=-1)
+  assert action.shape[-1] == action_size
+  return {'image': frames}, {'action': action.astype(np.float32)}
+
+
+class TestPackageSurface:
+
+  def test_exports_resolve(self):
+    assert seq2act.Seq2ActBCModel is not None
+    assert seq2act.RT1StyleNet is not None
+    assert seq2act.Seq2ActPreprocessor is not None
+
+
+class TestTransformerLayers:
+
+  def test_token_learner_pools_tokens(self):
+    tl = transformer_lib.TokenLearner(num_tokens=3)
+    x = np.random.RandomState(0).randn(2, 20, 8).astype(np.float32)
+    variables = tl.init(jax.random.PRNGKey(0), x)
+    out = tl.apply(variables, x)
+    assert out.shape == (2, 3, 8)
+
+  def test_causal_transformer_is_causal(self):
+    model = transformer_lib.CausalTransformer(
+        num_layers=2, num_heads=2, head_dim=8, mlp_dim=32, max_length=16,
+        attention_mode='xla')
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 12, 16).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    base = model.apply(variables, x)
+    x2 = x.copy()
+    x2[:, 9:] += 10.0  # perturb the future
+    out = model.apply(variables, x2)
+    np.testing.assert_allclose(np.asarray(out[:, :9]),
+                               np.asarray(base[:, :9]), atol=1e-5)
+    assert not np.allclose(np.asarray(out[:, 9:]), np.asarray(base[:, 9:]))
+
+  def test_flash_matches_dense(self):
+    rng = np.random.RandomState(2)
+    q = rng.randn(2, 64, 2, 16).astype(np.float32)
+    k = rng.randn(2, 64, 2, 16).astype(np.float32)
+    v = rng.randn(2, 64, 2, 16).astype(np.float32)
+    for causal in (False, True):
+      dense = transformer_lib.run_attention(q, k, v, mode='xla',
+                                            causal=causal)
+      flash = transformer_lib.run_attention(q, k, v, mode='flash',
+                                            causal=causal)
+      np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                                 atol=2e-3, rtol=1e-3)
+
+  def test_auto_mode_selects_dense_on_cpu(self):
+    q = np.zeros((1, 8, 1, 4), np.float32)
+    out = transformer_lib.run_attention(q, q, q, mode='auto', causal=True)
+    assert out.shape == q.shape
+
+  def test_ring_requires_mesh(self):
+    q = np.zeros((1, 8, 1, 4), np.float32)
+    with pytest.raises(ValueError, match='mesh'):
+      transformer_lib.run_attention(q, q, q, mode='ring', causal=False)
+
+
+class TestSeq2ActModel:
+
+  def test_predict_shapes(self):
+    model = Seq2ActBCModel(**TINY)
+    generator = DefaultRandomInputGenerator(batch_size=2)
+    generator.set_specification_from_model(model, ModeKeys.PREDICT)
+    features, _ = next(
+        generator.create_dataset_iterator(mode=ModeKeys.PREDICT, seed=0))
+    features, _ = model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT)
+    variables = model.init_variables(jax.random.PRNGKey(0), features,
+                                     mode=ModeKeys.PREDICT)
+    outputs, _ = model.inference_network_fn(variables, features,
+                                            mode=ModeKeys.PREDICT)
+    export = model.create_export_outputs_fn(features, outputs,
+                                            ModeKeys.PREDICT)
+    assert np.asarray(export['action']).shape == (2, 4, 2)
+    assert np.asarray(export['inference_output']).shape == (2, 2)
+    act = np.asarray(export['action'])
+    assert np.all(act >= -1.0) and np.all(act <= 1.0)
+
+  def test_token_learner_engaged_below_stem_tokens(self):
+    """tokens_per_frame < stem tokens routes through TokenLearner."""
+    cfg = dict(TINY)
+    cfg.update(tokens_per_frame=2)
+    model = Seq2ActBCModel(**cfg)
+    generator = DefaultRandomInputGenerator(batch_size=2)
+    generator.set_specification_from_model(model, ModeKeys.PREDICT)
+    features, _ = next(
+        generator.create_dataset_iterator(mode=ModeKeys.PREDICT, seed=0))
+    features, _ = model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT)
+    variables = model.init_variables(jax.random.PRNGKey(0), features,
+                                     mode=ModeKeys.PREDICT)
+    assert 'token_learner' in variables['params']['tokenizer']
+    outputs, _ = model.inference_network_fn(variables, features,
+                                            mode=ModeKeys.PREDICT)
+    assert np.asarray(outputs['action_logits']).shape == (
+        2, 4, TINY['action_size'] * TINY['vocab_size'])
+
+  def test_excess_tokens_per_frame_raises(self):
+    cfg = dict(TINY)
+    cfg.update(tokens_per_frame=64)  # stem yields only 4 for 32x32
+    model = Seq2ActBCModel(**cfg)
+    generator = DefaultRandomInputGenerator(batch_size=1)
+    generator.set_specification_from_model(model, ModeKeys.PREDICT)
+    features, _ = next(
+        generator.create_dataset_iterator(mode=ModeKeys.PREDICT, seed=0))
+    features, _ = model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT)
+    with pytest.raises(ValueError, match='num_tokens'):
+      model.init_variables(jax.random.PRNGKey(0), features,
+                           mode=ModeKeys.PREDICT)
+
+  def test_crop_larger_than_source_raises(self):
+    from tensor2robot_tpu.specs.struct import SpecStruct
+    cfg = dict(TINY)
+    cfg.update(img_res=(64, 64), src_img_res=(36, 36))
+    model = Seq2ActBCModel(**cfg)
+    frames = np.zeros((1, 4, 36, 36, 3), np.uint8)
+    with pytest.raises(ValueError, match='exceeds'):
+      model.preprocessor.preprocess(SpecStruct(image=frames), None,
+                                    ModeKeys.TRAIN,
+                                    rng=jax.random.PRNGKey(0))
+
+  def test_model_level_causality(self):
+    """Actions at step t must ignore frames after t (deployment contract:
+    the policy replays a growing episode prefix)."""
+    model = Seq2ActBCModel(**TINY)
+    rng = np.random.RandomState(3)
+    features, _ = _episode_batch(rng, 2)
+    feats = {'image': features['image']}
+    from tensor2robot_tpu.specs.struct import SpecStruct
+    f1, _ = model.preprocessor.preprocess(
+        SpecStruct(**feats), None, ModeKeys.PREDICT)
+    variables = model.init_variables(jax.random.PRNGKey(0), f1,
+                                     mode=ModeKeys.PREDICT)
+    out1, _ = model.inference_network_fn(variables, f1,
+                                         mode=ModeKeys.PREDICT)
+    a1 = model.create_export_outputs_fn(f1, out1, ModeKeys.PREDICT)['action']
+    feats2 = {'image': features['image'].copy()}
+    feats2['image'][:, -1] = 255 - feats2['image'][:, -1]  # change last frame
+    f2, _ = model.preprocessor.preprocess(
+        SpecStruct(**feats2), None, ModeKeys.PREDICT)
+    out2, _ = model.inference_network_fn(variables, f2,
+                                         mode=ModeKeys.PREDICT)
+    a2 = model.create_export_outputs_fn(f2, out2, ModeKeys.PREDICT)['action']
+    np.testing.assert_allclose(np.asarray(a1)[:, :-1],
+                               np.asarray(a2)[:, :-1], atol=1e-5)
+
+  def test_learns_temporal_imitation_rule(self):
+    """The learning test VERDICT-r2 asked for: loss drops on a rule where
+    one action dimension copies a cue from TWO FRAMES EARLIER — solvable
+    only by attending across time. Asserts per-dimension held-out
+    accuracy: dim 1 above 5x the 1-in-16-bins chance rate."""
+    from tensor2robot_tpu.research.vrgripper import decoders
+    from tensor2robot_tpu.specs.struct import SpecStruct
+
+    model = Seq2ActBCModel(learning_rate=3e-3, **TINY)
+    rng = np.random.RandomState(0)
+    f, l = _episode_batch(rng, 16)
+    feats, labs = model.preprocessor.preprocess(
+        SpecStruct(**f), SpecStruct(**l), ModeKeys.TRAIN,
+        rng=jax.random.PRNGKey(0))
+    state = model.create_train_state(jax.random.PRNGKey(1), feats, labs)
+    step = jax.jit(model.train_step)
+    first_loss = None
+    for i in range(400):
+      f, l = _episode_batch(rng, 16)
+      feats, labs = model.preprocessor.preprocess(
+          SpecStruct(**f), SpecStruct(**l), ModeKeys.TRAIN,
+          rng=jax.random.PRNGKey(i))
+      state, metrics = step(state, feats, labs, jax.random.PRNGKey(1000 + i))
+      if first_loss is None:
+        first_loss = float(metrics['loss'])
+    last_loss = float(metrics['loss'])
+    # Held-out per-dimension accuracy on a fresh batch.
+    f, l = _episode_batch(rng, 64)
+    feats, _ = model.preprocessor.preprocess(SpecStruct(**f), None,
+                                             ModeKeys.PREDICT)
+    out, _ = model.inference_network_fn(state.variables(), feats,
+                                        mode=ModeKeys.PREDICT)
+    pred = np.asarray(decoders.get_discrete_actions(
+        out['action_logits'], 2, TINY['vocab_size'], model._bin_centers))
+    err = np.abs(pred - l['action'])
+    half_bin = 2.0 / TINY['vocab_size'] / 2 + 1e-6
+    acc = (err <= half_bin).mean(axis=(0, 1))
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    assert acc[0] > 0.3, acc  # current-frame dimension
+    assert acc[1] > 0.3, acc  # the temporal dimension (chance ~0.06)
+
+  def test_train_export_predict_parity(self, tmp_path):
+    model = Seq2ActBCModel(**TINY)
+    rng = np.random.RandomState(1)
+    generator = GeneratorInputGenerator(
+        batch_generator_fn=lambda b: _episode_batch(rng, b), batch_size=8)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    try:
+      state = trainer.train(generator, max_train_steps=2)
+      features, _ = _episode_batch(rng, 8)  # divisible by the 8-way mesh
+      from tensor2robot_tpu.specs.struct import SpecStruct
+      feats = SpecStruct(image=features['image'])
+      expected = trainer.predict(state, feats)
+      predictor = CheckpointPredictor(Seq2ActBCModel(**TINY),
+                                      trainer.model_dir, timeout=5.0)
+      assert predictor.restore()
+      outputs = predictor.predict({'image': features['image']})
+      assert np.asarray(outputs['action']).shape == (8, 4, 2)
+      np.testing.assert_allclose(
+          np.asarray(outputs['action']), np.asarray(expected['action']),
+          atol=1e-5)
+    finally:
+      trainer.close()
+
+
+class TestConfig:
+
+  def test_gin_config_parses_and_builds_model(self):
+    import os
+    from tensor2robot_tpu import config
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config.register_framework_configurables()
+    config.add_config_file_search_path(repo_root)
+    config.parse_config_files_and_bindings(
+        [os.path.join(repo_root, 'tensor2robot_tpu/research/seq2act/configs/'
+                      'train_seq2act_bc.gin')],
+        ['Seq2ActBCModel.device_type = "cpu"'])
+    model = config.query_parameter('train_eval_model.t2r_model')
+    assert isinstance(model, Seq2ActBCModel)
+    assert model.episode_length == 6
+    spec = model.get_feature_specification(ModeKeys.TRAIN)
+    assert tuple(spec['image'].shape) == (6, 128, 160, 3)
+
+
+class TestRingAttention:
+  """The long-context variant: ring attention over the 8-device mesh."""
+
+  def _ring_config(self, mesh):
+    cfg = dict(TINY)
+    # 8 frames x 4 tokens = 32 tokens -> 4 per device on the 8-way mesh.
+    cfg.update(episode_length=8, attention_mode='ring', mesh=mesh)
+    return cfg
+
+  def test_ring_matches_dense_and_trains(self, tmp_path):
+    mesh = parallel.create_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+    ring_model = Seq2ActBCModel(**self._ring_config(mesh))
+    dense_cfg = self._ring_config(mesh)
+    dense_cfg.update(attention_mode='xla', mesh=None)
+    dense_model = Seq2ActBCModel(**dense_cfg)
+
+    rng = np.random.RandomState(5)
+    features, labels = _episode_batch(rng, 2, episode_length=8)
+    from tensor2robot_tpu.specs.struct import SpecStruct
+    feats = SpecStruct(image=features['image'])
+    labs = SpecStruct(action=labels['action'])
+    feats, labs = dense_model.preprocessor.preprocess(
+        feats, labs, ModeKeys.EVAL)
+    variables = dense_model.init_variables(jax.random.PRNGKey(0), feats,
+                                           mode=ModeKeys.EVAL)
+    out_dense, _ = dense_model.inference_network_fn(
+        variables, feats, mode=ModeKeys.EVAL)
+    out_ring, _ = ring_model.inference_network_fn(
+        variables, feats, mode=ModeKeys.EVAL)
+    np.testing.assert_allclose(
+        np.asarray(out_ring['action_logits']),
+        np.asarray(out_dense['action_logits']), atol=2e-3, rtol=1e-3)
+
+    # One full training step with ring attention on the mesh.
+    state = ring_model.create_train_state(jax.random.PRNGKey(1), feats, labs)
+    step = jax.jit(ring_model.train_step)
+    new_state, metrics = step(state, feats, labs, jax.random.PRNGKey(2))
+    assert int(jax.device_get(new_state.step)) == 1
+    assert np.isfinite(float(metrics['loss']))
