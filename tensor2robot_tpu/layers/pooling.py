@@ -134,11 +134,16 @@ def max_pool(x: jnp.ndarray, window_shape: Sequence[int],
   this framework, is fully supported. Forward-mode callers get the
   reduce-window fallback by calling ``flax.linen.max_pool`` directly.
   """
-  window_shape, strides = tuple(window_shape), tuple(strides)
+  window_shape = tuple(window_shape)
+  if strides is None:
+    # flax's default (None == stride 1): overlapping by construction, so
+    # the fast path never applies — defer entirely to nn.max_pool.
+    return nn.max_pool(x, window_shape, strides=None, padding=padding)
+  strides = tuple(strides)
   per_image = 1
   for d in x.shape[1:]:
     per_image *= d
-  if (window_shape == strides and x.ndim == 4 and
+  if (window_shape == strides and len(window_shape) == 2 and x.ndim == 4 and
       padding in ('SAME', 'VALID') and
       max(window_shape) <= 127 and  # index grids are int8
       per_image <= _INDEX_PATH_MAX_ELEMENTS_PER_IMAGE):

@@ -126,6 +126,13 @@ class ExportedModelPredictor(AbstractPredictor):
 
   # -- serving ---------------------------------------------------------------
 
+  @property
+  def variables(self):
+    """The restored variables pytree (for custom jitted serving paths,
+    e.g. DeviceCEMPolicy's one-dispatch CEM — checkpoint_predictor parity)."""
+    self.assert_is_loaded()
+    return self._variables
+
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     self.assert_is_loaded()
     if self._serve_fn is not None:

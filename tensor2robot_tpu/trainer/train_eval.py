@@ -198,9 +198,10 @@ class Trainer:
     init_fn = jax.jit(
         lambda f, l: self.model.create_train_state(rng, f, l),
         out_shardings=self._state_sharding)
-    batch_sharding = self._batch_sharding()
-    features = jax.device_put(features.to_dict(), batch_sharding)
-    labels = (jax.device_put(labels.to_dict(), batch_sharding)
+    # shard_batch, not device_put: multi-process hosts hold only their
+    # slice of the global batch (parallel/sharding.py:59-74).
+    features = sharding_lib.shard_batch(features.to_dict(), self.mesh)
+    labels = (sharding_lib.shard_batch(labels.to_dict(), self.mesh)
               if labels is not None else None)
     return init_fn(features, labels)
 
@@ -279,11 +280,24 @@ class Trainer:
             input_generator: AbstractInputGenerator,
             max_train_steps: int,
             state: Optional[TrainState] = None,
-            hooks: Sequence[Any] = ()) -> TrainState:
-    """Runs the training loop up to global step ``max_train_steps``."""
+            hooks: Sequence[Any] = (),
+            shard_index: Optional[int] = None,
+            num_shards: Optional[int] = None) -> TrainState:
+    """Runs the training loop up to global step ``max_train_steps``.
+
+    ``shard_index``/``num_shards`` select this host's slice of the input
+    files; they default to the JAX process index/count, so multi-host
+    training reads per-host shards with no extra wiring (the PER_HOST_V2
+    contract, ref utils/tfdata.py:43-66).
+    """
+    if shard_index is None:
+      shard_index = jax.process_index()
+    if num_shards is None:
+      num_shards = jax.process_count()
     input_generator = provide_input_generator_with_model_information(
         input_generator, self.model, ModeKeys.TRAIN)
-    iterator = input_generator.create_dataset_iterator(mode=ModeKeys.TRAIN)
+    iterator = input_generator.create_dataset_iterator(
+        mode=ModeKeys.TRAIN, shard_index=shard_index, num_shards=num_shards)
     features, labels = next(iterator)
     if state is None:
       state = self.init_state(features, labels)

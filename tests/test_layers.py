@@ -370,6 +370,28 @@ class TestFastMaxPool:
     got = pooling.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
     np.testing.assert_allclose(np.asarray(got), np.asarray(want))
 
+  def test_strides_none_matches_flax_default(self):
+    """flax's strides=None (stride 1) must not crash the fast-path gate
+    (ADVICE r2: it used to TypeError at tuple(strides))."""
+    from tensor2robot_tpu.layers import pooling
+    import flax.linen as nn
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 6, 6, 3), jnp.float32)
+    want = nn.max_pool(x, (2, 2), strides=None, padding='VALID')
+    got = pooling.max_pool(x, (2, 2), strides=None, padding='VALID')
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+  def test_3d_window_falls_back(self):
+    """A 3-dim window (5D input) must take the nn.max_pool path, not crash
+    inside the 2D fast path (ADVICE r2)."""
+    from tensor2robot_tpu.layers import pooling
+    import flax.linen as nn
+    x = jnp.asarray(np.random.RandomState(3).randn(1, 4, 4, 4, 2),
+                    jnp.float32)
+    want = nn.max_pool(x, (2, 2, 2), strides=(2, 2, 2), padding='VALID')
+    got = pooling.max_pool(x, (2, 2, 2), strides=(2, 2, 2),
+                           padding='VALID')
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
 
 class TestPallasMaxPool:
   """Interpret-mode parity for the Pallas pool kernel (layers/pallas_pooling).

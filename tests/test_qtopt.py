@@ -221,6 +221,138 @@ class TestEndToEnd:
     predictor.close()
 
 
+class TestStemBiasInvariance:
+  """Pins the topology assumption behind stop_gradient(conv1_1 bias)
+  (ADVICE r2, networks.py:113): the train-mode loss must be INVARIANT to
+  the conv1_1 bias value, because bn1's batch statistics are computed over
+  the same biased pre-pool tensor (and a per-channel shift commutes with
+  max pooling). If a future topology edit adds another consumer of the
+  stem output or swaps bn1, this fails loudly instead of silently training
+  with a wrong (zero) bias gradient."""
+
+  def test_train_loss_invariant_to_conv1_bias(self):
+    import jax.numpy as jnp
+
+    model = _make_model()
+    generator = DefaultRandomInputGenerator(batch_size=2)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(
+        generator.create_dataset_iterator(mode=ModeKeys.TRAIN, seed=0))
+    features, labels = model.preprocessor.preprocess(
+        features, labels, ModeKeys.TRAIN, rng=jax.random.PRNGKey(1))
+    variables = model.init_variables(jax.random.PRNGKey(0), features, labels)
+    params = variables.pop('params')
+
+    def _loss(p):
+      loss, _ = model.loss_fn(p, variables, features, labels,
+                              ModeKeys.TRAIN, jax.random.PRNGKey(2))
+      return float(loss)
+
+    # Locate the conv1_1 bias leaf and shift it hard.
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    bias_path = None
+    for path, leaf in flat:
+      keys = '/'.join(str(getattr(k, 'key', k)) for k in path)
+      if 'conv1_1' in keys and 'bias' in keys:
+        bias_path = keys
+        break
+    assert bias_path is not None, 'conv1_1 bias not found'
+
+    def _shift(p):
+      def _maybe(path, leaf):
+        keys = '/'.join(str(getattr(k, 'key', k)) for k in path)
+        return leaf + 5.0 if keys == bias_path else leaf
+      return jax.tree_util.tree_map_with_path(_maybe, p)
+
+    base = _loss(params)
+    shifted = _loss(_shift(params))
+    np.testing.assert_allclose(shifted, base, rtol=1e-4)
+
+
+class TestFullFidelitySystems:
+  """The VERDICT-r2 item-7 systems test: reference-format 512x640 JPEG
+  records on disk -> (native C++ loader) -> Grasping44 training -> atomic
+  versioned export -> polling predictor -> DeviceCEMPolicy action, i.e.
+  the complete filesystem transport contract with no synthetic resident
+  batches anywhere."""
+
+  def test_disk_records_to_cem_action(self, tmp_path):
+    from tensor2robot_tpu.data import tfrecord
+    from tensor2robot_tpu.data.parser import build_example_for_specs
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.export.exporters import LatestModelExporter
+    from tensor2robot_tpu.policies import DeviceCEMPolicy
+    from tensor2robot_tpu.predictors import ExportedModelPredictor
+    from tensor2robot_tpu.specs.struct import SpecStruct
+    from tensor2robot_tpu.utils.image import numpy_to_image_string
+
+    model = _make_model()
+    in_features = model.preprocessor.get_in_feature_specification(
+        ModeKeys.TRAIN)
+    in_labels = model.preprocessor.get_in_label_specification(ModeKeys.TRAIN)
+    spec = SpecStruct(f=in_features, l=in_labels)
+
+    # Collect side: 48 grasp attempts as reference-format records — full
+    # 512x640 JPEG camera frames, grasp params, success label.
+    rng = np.random.RandomState(0)
+    records = []
+    for i in range(48):
+      frame = np.tile(
+          rng.randint(0, 255, (512, 640, 1), dtype=np.uint8), (1, 1, 3))
+      values = SpecStruct()
+      for key in in_features:
+        if key == 'state/image':
+          values['f/' + key] = numpy_to_image_string(frame)
+        else:
+          shape = tuple(in_features[key].shape)
+          values['f/' + key] = rng.rand(*shape).astype(np.float32)
+      close = np.asarray([float(i % 2)], np.float32)
+      values['f/action/close_gripper'] = close
+      values['l/reward'] = close.copy()  # success == closed gripper
+      records.append(build_example_for_specs(spec, values))
+    record_path = str(tmp_path / 'grasps-00000.tfrecord')
+    tfrecord.write_records(record_path, records)
+
+    # Learner side: train FROM DISK through the input pipeline.
+    generator = DefaultRecordInputGenerator(file_patterns=record_path,
+                                            batch_size=8)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    assert generator._native_iterator(ModeKeys.TRAIN, 1, 0, 1, 0) is not None, (
+        'QT-Opt in-specs must ride the native C++ loader fast path')
+    trainer = Trainer(model, str(tmp_path / 'run'), async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=10**9)
+    try:
+      state = trainer.train(generator, max_train_steps=2,
+                            shard_index=0, num_shards=1)
+      assert int(jax.device_get(state.step)) == 2
+      # Export side: atomic versioned artifact with t2r assets.
+      exporter = LatestModelExporter()
+      export_path = exporter.export(trainer, state, {'loss': 1.0})
+      assert export_path is not None
+      export_root = exporter.export_root(trainer)
+    finally:
+      trainer.close()
+
+    # Robot side: poll the export dir, restore, one-dispatch CEM action.
+    serving_model = _make_model()
+    predictor = ExportedModelPredictor(export_root,
+                                       t2r_model=serving_model, timeout=5.0)
+    assert predictor.restore()
+    assert predictor.global_step == 2
+    policy = DeviceCEMPolicy(t2r_model=serving_model, cem_iters=2,
+                             cem_samples=8, num_elites=3,
+                             predictor=predictor)
+    obs = {'image': np.tile(rng.randint(0, 255, (512, 640, 1), np.uint8),
+                            (1, 1, 3)),
+           'gripper_closed': 0.0, 'height_to_bottom': 0.1}
+    action = policy.SelectAction(obs, None, 0)
+    assert np.asarray(action).shape == (CEM_ACTION_SIZE,)
+    assert np.all(np.isfinite(np.asarray(action)))
+    predictor.close()
+
+
 class TestLearningDynamics:
 
   def test_critic_learns_action_conditional_rule(self, tmp_path):
