@@ -33,3 +33,12 @@ from tensor2robot_tpu.data.input_generators import (
     get_multi_eval_name,
 )
 from tensor2robot_tpu.data.writer import TFRecordReplayWriter
+from tensor2robot_tpu.data.native_loader import (
+    NativeBatchedStream,
+    build_native,
+    plan_for_specs,
+)
+from tensor2robot_tpu.data.jpeg_device import (
+    decode_coef_features,
+    decode_jpeg_coefficients,
+)

@@ -95,6 +95,7 @@ class AbstractInputGenerator(abc.ABC):
     self._prefetch = int(prefetch)
     self._feature_spec = None
     self._label_spec = None
+    self._raw_feature_spec = None  # device-decode: on-disk JPEG specs
     self._preprocess_fn = None
 
   @property
@@ -110,6 +111,11 @@ class AbstractInputGenerator(abc.ABC):
 
     ref: abstract_input_generator.py:80 — the input pipeline produces what the
     preprocessor consumes, not what the model consumes.
+
+    A DeviceDecodePreprocessor wrapper is recognized: the generator then
+    plans the native loader in COEF mode against the raw (on-disk JPEG)
+    specs and ships DCT coefficient tensors the wrapper finishes decoding
+    on device.
     """
     assert_valid_mode(mode)
     preprocessor = model.preprocessor
@@ -117,6 +123,10 @@ class AbstractInputGenerator(abc.ABC):
     self._label_spec = preprocessor.get_in_label_specification(mode)
     specs_lib.assert_valid_spec_structure(self._feature_spec)
     specs_lib.assert_valid_spec_structure(self._label_spec)
+    self._raw_feature_spec = None
+    if hasattr(preprocessor, 'raw_in_feature_specification'):
+      self._raw_feature_spec = preprocessor.raw_in_feature_specification(
+          mode)
 
   def set_specification(self, feature_spec, label_spec) -> None:
     self._feature_spec = specs_lib.flatten_spec_structure(feature_spec)
@@ -206,6 +216,40 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     """Returns a native-loader batch iterator, or None to fall back."""
     from tensor2robot_tpu.data import native_loader
 
+    if self._raw_feature_spec is not None:
+      # Device-decode wrapper in play: plan against the on-disk JPEG specs
+      # in coef mode; the stream's key/{y,cb,cr,qt} outputs match the
+      # wrapper's in-specs. No Python fallback exists for coef shipping —
+      # every unavailability is a hard error, never a silent fallthrough
+      # to a parser that cannot produce coefficient tensors.
+      if self._use_native is False or not native_loader.native_loader_enabled():
+        raise ValueError(
+            'DeviceDecodePreprocessor requires the native loader '
+            '(use_native must not be False; T2R_NATIVE_LOADER must not '
+            'disable it).')
+      if self._dataset_map is not None:
+        raise ValueError(
+            'DeviceDecodePreprocessor does not support multi-dataset zip.')
+      plan = native_loader.plan_for_specs(
+          self._raw_feature_spec, self._label_spec, image_mode='coef')
+      if plan is None:
+        raise ValueError(
+            'DeviceDecodePreprocessor requires the native loader fast path '
+            '(plain Example, fixed shapes, 4:2:0-eligible JPEG specs).')
+      _, files = parse_file_patterns(self._dataset_files()[''])
+      files = files[shard_index::num_shards]
+      if not files:
+        raise ValueError(
+            'Host {} of {} has no record files for the device-decode '
+            'stream; provide at least num_shards files.'.format(
+                shard_index, num_shards))
+      stream = native_loader.NativeBatchedStream(
+          plan, files, batch_size=self._batch_size,
+          shuffle=(mode == ModeKeys.TRAIN),
+          shuffle_buffer=self._shuffle_buffer_size,
+          num_epochs=num_epochs, seed=seed,
+          num_threads=self._num_native_threads, validate=False)
+      return iter(stream)
     if self._use_native is False or not native_loader.native_loader_enabled():
       return None
     if self._dataset_map is not None:

@@ -178,7 +178,10 @@ struct FieldSpec {
   FieldKind kind;
   int dtype_size;  // int fields: output width in bytes (1, 4, 8)
   int h = 0, w = 0, c = 0;  // image fields
-  long long count = 0;      // float/int fields: elements per row
+  // float/int fields: elements per row. image_full fields: number of
+  // frames (a rank-4 [T, H, W, C] spec stores T JPEGs as a bytes list;
+  // 0/1 means a single [H, W, C] image).
+  long long count = 0;
   // Buffer indices into Slot::buffers (filled at config time).
   int buf0 = -1;            // primary (float/int/u8 pixels, or coef Y)
   int buf_cb = -1, buf_cr = -1, buf_qt = -1;  // image_coef extras
@@ -255,10 +258,13 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
         f.buf0 = (int)cfg->buffer_sizes.size();
         cfg->buffer_sizes.push_back(B * f.count * f.dtype_size);
         break;
-      case kImageFull:
+      case kImageFull: {
+        long long frames = f.count > 1 ? f.count : 1;
         f.buf0 = (int)cfg->buffer_sizes.size();
-        cfg->buffer_sizes.push_back(B * (long long)f.h * f.w * f.c);
+        cfg->buffer_sizes.push_back(B * frames * (long long)f.h * f.w *
+                                    f.c);
         break;
+      }
       case kImageCoef: {
         if (f.h % 16 || f.w % 16 || f.c != 3) {
           *err = "image_coef requires HxW multiple of 16 and c=3: " + f.name;
@@ -715,15 +721,31 @@ struct Loader {
         case 1: {  // BytesList
           if (f.kind != kImageFull && f.kind != kImageCoef)
             return "feature '" + f.name + "' is bytes but spec is numeric";
-          // First bytes element is the payload.
+          long long frames = (f.kind == kImageFull && f.count > 1)
+                                 ? f.count : 1;
+          long long got = 0;
           uint32_t wt2;
           while (uint32_t f2 = list.tag(&wt2)) {
             if (f2 == 1 && wt2 == 2) {
               Cursor payload = list.bytes();
+              if (got >= frames) {
+                if (frames == 1) continue;  // rank-3 spec: first element
+                                            // wins, extras ignored
+                                            // (Python parser parity)
+                char buf[128];
+                snprintf(buf, sizeof buf, "feature '%s': more than %lld "
+                         "encoded frames", f.name.c_str(), frames);
+                return buf;
+              }
               if (f.kind == kImageFull) {
                 uint8_t* out = slot.buffers[f.buf0] +
-                               (size_t)row * f.h * f.w * f.c;
-                return decode_jpeg_full(payload.p, payload.size(), f, out);
+                               ((size_t)row * frames + got) *
+                                   f.h * f.w * f.c;
+                std::string err =
+                    decode_jpeg_full(payload.p, payload.size(), f, out);
+                if (!err.empty()) return err;
+                got++;
+                continue;
               }
               long long yb = (long long)(f.h / 8) * (f.w / 8) * 64;
               long long cb_n = (long long)(f.h / 16) * (f.w / 16) * 64;
@@ -736,7 +758,14 @@ struct Loader {
             }
             list.skip(wt2);
           }
-          return "empty bytes list for '" + f.name + "'";
+          if (f.kind == kImageFull && got != frames) {
+            char buf[128];
+            snprintf(buf, sizeof buf, "feature '%s': got %lld encoded "
+                     "frames, want %lld", f.name.c_str(), got, frames);
+            return buf;
+          }
+          if (got == 0) return "empty bytes list for '" + f.name + "'";
+          return "";
         }
         case 2: {  // FloatList
           if (f.kind != kFloat)

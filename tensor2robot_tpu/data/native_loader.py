@@ -106,7 +106,9 @@ class _Field:
     self.shape = shape        # per-row output shape
     self.view_dtype = view_dtype
     self.count = count
-    h, w, c = (shape + (0, 0, 0))[:3] if kind in (
+    # Images: last three dims are H, W, C (rank-4 specs carry a leading
+    # frame count, which travels in ``count``).
+    h, w, c = shape[-3:] if kind in (
         _KIND_IMAGE_FULL, _KIND_IMAGE_COEF) else (0, 0, 0)
     self.h, self.w, self.c = h, w, c
 
@@ -159,17 +161,23 @@ def plan_for_specs(feature_spec, label_spec,
       if spec.is_encoded_image:
         if spec.data_format not in (None, 'jpeg', 'JPEG', 'jpg'):
           return None
-        if len(shape) != 3 or spec.dtype != np.uint8 or shape[-1] not in (
-            1, 3):
+        if len(shape) not in (3, 4) or spec.dtype != np.uint8 \
+            or shape[-1] not in (1, 3):
           return None
         if image_mode == 'coef':
-          if shape[0] % 16 or shape[1] % 16 or shape[-1] != 3:
+          if len(shape) != 4 and (shape[0] % 16 or shape[1] % 16
+                                  or shape[-1] != 3):
             return None
+          if len(shape) == 4:
+            return None  # coef mode: single-frame specs only
           fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
                                np.int16))
         else:
+          # Rank-4 [T, H, W, C]: a fixed-length list of T encoded frames
+          # (episode data, e.g. seq2act); count carries T to the C++ side.
+          frames = shape[0] if len(shape) == 4 else 0
           fields.append(_Field(full_key, spec, _KIND_IMAGE_FULL, 1, shape,
-                               np.uint8))
+                               np.uint8, count=frames))
       elif spec.dtype == np.dtype(object):
         return None
       elif spec.dtype in (np.float32, bfloat16):

@@ -1,0 +1,118 @@
+"""DeviceDecodePreprocessor: train straight from DCT coefficients.
+
+The trainable half of the split-decode input path (SURVEY hard-part #3).
+Wrapping a model's preprocessor::
+
+    model.set_preprocessor(DeviceDecodePreprocessor(model.preprocessor))
+
+changes its IN-specs so the input pipeline ships quantized JPEG
+coefficient blocks instead of decoded pixels — the native loader's
+``image_mode='coef'`` output (data/native/record_loader.cc stops after
+the entropy stage, ~1.5x host throughput) — and finishes the decode
+(dequant + 8x8 IDCT on the MXU + chroma upsample + YCbCr->RGB,
+data/jpeg_device.py) INSIDE the jitted train step before the wrapped
+preprocessor runs. DefaultRecordInputGenerator detects the wrapper and
+plans the native loader in coef mode automatically.
+
+Eligible image specs: rank-3 uint8 JPEG with H and W divisible by 16
+(baseline 4:2:0). Other specs pass through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu.data import jpeg_device
+from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
+    AbstractPreprocessor,
+)
+from tensor2robot_tpu.specs import algebra
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+def _coef_eligible(spec: TensorSpec) -> bool:
+  shape = tuple(spec.shape or ())
+  return (spec.is_encoded_image
+          and spec.data_format in (None, 'jpeg', 'JPEG', 'jpg')
+          and len(shape) == 3 and shape[-1] == 3
+          and spec.dtype == np.uint8
+          and shape[0] % 16 == 0 and shape[1] % 16 == 0)
+
+
+def coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
+  """The four coefficient tensors replacing one image spec."""
+  h, w, _ = spec.shape
+  out = SpecStruct()
+  out[key + '/y'] = TensorSpec((h // 8, w // 8, 64), np.int16,
+                               name=(spec.name or key) + '/y')
+  out[key + '/cb'] = TensorSpec((h // 16, w // 16, 64), np.int16,
+                                name=(spec.name or key) + '/cb')
+  out[key + '/cr'] = TensorSpec((h // 16, w // 16, 64), np.int16,
+                                name=(spec.name or key) + '/cr')
+  out[key + '/qt'] = TensorSpec((3, 64), np.uint16,
+                                name=(spec.name or key) + '/qt')
+  return out
+
+
+class DeviceDecodePreprocessor(AbstractPreprocessor):
+  """Wraps a preprocessor to accept coefficient inputs (module docstring)."""
+
+  def __init__(self, inner: AbstractPreprocessor):
+    super().__init__(inner._model_feature_specification_fn,
+                     inner._model_label_specification_fn)
+    self._inner = inner
+    keys = self.image_keys('train')
+    if not keys:
+      raise ValueError(
+          'DeviceDecodePreprocessor: the wrapped preprocessor declares no '
+          'coef-eligible image specs (rank-3 uint8 JPEG, dims % 16 == 0).')
+
+  @property
+  def inner(self) -> AbstractPreprocessor:
+    return self._inner
+
+  def image_keys(self, mode: str) -> List[str]:
+    spec = algebra.flatten_spec_structure(
+        self._inner.get_in_feature_specification(mode))
+    return [key for key in spec if _coef_eligible(spec[key])]
+
+  def raw_in_feature_specification(self, mode: str) -> SpecStruct:
+    """The inner (on-disk JPEG) in-specs — what the record loader plans."""
+    return self._inner.get_in_feature_specification(mode)
+
+  def get_in_feature_specification(self, mode: str) -> SpecStruct:
+    spec = algebra.flatten_spec_structure(
+        self._inner.get_in_feature_specification(mode))
+    out = SpecStruct()
+    for key in spec:
+      if _coef_eligible(spec[key]):
+        for ckey, cspec in coef_specs(key, spec[key]).items():
+          out[ckey] = cspec
+      else:
+        out[key] = spec[key]
+    return out
+
+  def get_in_label_specification(self, mode: str) -> SpecStruct:
+    return self._inner.get_in_label_specification(mode)
+
+  def get_out_feature_specification(self, mode: str) -> SpecStruct:
+    return self._inner.get_out_feature_specification(mode)
+
+  def get_out_label_specification(self, mode: str) -> SpecStruct:
+    return self._inner.get_out_label_specification(mode)
+
+  def preprocess(self, features, labels, mode: str, rng=None
+                 ) -> Tuple[SpecStruct, SpecStruct]:
+    """Finish the JPEG decode on device, then run the wrapped preprocessor
+    (which validates against its own in-specs)."""
+    features = SpecStruct(**{k: features[k] for k in features})
+    features = jpeg_device.decode_coef_features(
+        features, self.image_keys(mode))
+    return self._inner.preprocess(features, labels, mode, rng=rng)
+
+  def _preprocess_fn(self, features, labels, mode: str, rng=None):
+    raise AssertionError(
+        'DeviceDecodePreprocessor overrides preprocess() directly.')

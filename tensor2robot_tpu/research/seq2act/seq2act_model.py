@@ -292,6 +292,25 @@ class Seq2ActBCModel(AbstractT2RModel):
                                         inference_outputs, mode)
     return metrics
 
+  def pack_features(self, state, context, timestep) -> dict:
+    """Rolling episode window for robot-time serving.
+
+    ``state``: observation dict with 'image' ([H, W, 3] uint8 at SOURCE
+    resolution). ``context``: the previous call's return value (None on
+    the first step — SequentialRegressionPolicy threads it,
+    policies/policies.py:228). The newest frame enters at the end of the
+    [1, T, H, W, 3] window; before T real frames exist the first frame
+    repeats, matching the training-time padding convention that episode
+    starts see a static camera.
+    """
+    frame = np.asarray(state['image'], np.uint8)[None, None]  # [1,1,H,W,3]
+    if context is None:
+      window = np.repeat(frame, self._episode_length, axis=1)
+    else:
+      prev = np.asarray(context['image'])
+      window = np.concatenate([prev[:, 1:], frame], axis=1)
+    return {'image': window}
+
   def create_export_outputs_fn(self, features, inference_outputs, mode: str
                                ) -> SpecStruct:
     logits = inference_outputs['action_logits']

@@ -188,6 +188,55 @@ class TestNativeStream:
     (feats, _), = self._native_batches(path, 1, num_epochs=1)
     assert np.all(np.asarray(feats['image']) == 0)
 
+  def test_episode_frame_list(self, tmp_path):
+    """Rank-4 [T, H, W, C] image specs (a bytes list of T JPEGs — the
+    seq2act episode layout) decode on the native path and match the
+    Python parser."""
+    path = str(tmp_path / 'episodes.tfrecord')
+    features = SpecStruct(
+        frames=TensorSpec((3, 32, 48, 3), np.uint8, name='ep/frames',
+                          data_format='jpeg'),
+        pose=TensorSpec((4,), np.float32, name='pose'))
+    rng = np.random.RandomState(0)
+    records = []
+    for _ in range(5):
+      jpegs = [numpy_to_image_string(
+          rng.randint(0, 255, (32, 48, 3), dtype=np.uint8))
+          for _ in range(3)]
+      records.append(build_example(
+          {'ep/frames': jpegs, 'pose': rng.rand(4).astype(np.float32)}))
+    tfrecord.write_records(path, records)
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    assert plan is not None
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=2,
+                                               num_epochs=1)
+    try:
+      batches = list(stream)
+    finally:
+      stream.close()
+    assert len(batches) == 2
+    parser = ExampleParser(features, SpecStruct())
+    ref, _ = parser.parse_batch(records[:2])
+    np.testing.assert_array_equal(np.asarray(batches[0][0]['frames']),
+                                  np.asarray(ref['frames']))
+    assert np.asarray(batches[0][0]['frames']).shape == (2, 3, 32, 48, 3)
+
+  def test_episode_frame_count_mismatch_raises(self, tmp_path):
+    path = str(tmp_path / 'short.tfrecord')
+    features = SpecStruct(
+        frames=TensorSpec((3, 32, 48, 3), np.uint8, name='ep/frames',
+                          data_format='jpeg'))
+    img = numpy_to_image_string(np.zeros((32, 48, 3), np.uint8))
+    tfrecord.write_records(path, [build_example({'ep/frames': [img, img]})])
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=1,
+                                               num_epochs=1)
+    try:
+      with pytest.raises(RuntimeError, match='frames'):
+        list(stream)
+    finally:
+      stream.close()
+
   def test_bfloat16_field(self, tmp_path):
     path = str(tmp_path / 'bf16.tfrecord')
     features = SpecStruct(x=TensorSpec((3,), bfloat16, name='x'))

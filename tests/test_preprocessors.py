@@ -212,3 +212,134 @@ class TestImageTransformations:
         p.get_in_feature_specification(ModeKeys.TRAIN), batch_size=4)
     value = step(features, jax.random.PRNGKey(0))
     assert np.isfinite(float(value))
+
+
+class TestDeviceDecodePreprocessor:
+  """Split-decode training path: coef records in, decoded pixels inside
+  the jitted step (preprocessors/device_decode.py)."""
+
+  def _image_model(self):
+    import flax.linen as nn
+    from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+
+    class _Net(nn.Module):
+
+      @nn.compact
+      def __call__(self, features, mode='train', train=False):
+        img = jnp.asarray(features['image'], jnp.float32) / 255.0
+        pooled = img.mean(axis=(1, 2))
+        return {'logits': nn.Dense(1, name='head')(pooled)}
+
+    class _ImageModel(AbstractT2RModel):
+
+      def __init__(self):
+        super().__init__(device_type='cpu')
+
+      def get_feature_specification(self, mode):
+        return SpecStruct(image=TensorSpec(
+            (64, 64, 3), np.uint8, name='frame', data_format='jpeg'))
+
+      def get_label_specification(self, mode):
+        return SpecStruct(target=TensorSpec((1,), np.float32,
+                                            name='target'))
+
+      def create_network(self):
+        return _Net()
+
+      def model_train_fn(self, variables, features, labels,
+                         inference_outputs, mode):
+        loss = jnp.mean(
+            (inference_outputs['logits'] -
+             jnp.asarray(labels['target'], jnp.float32)) ** 2)
+        return loss, SpecStruct(loss=loss)
+
+    return _ImageModel()
+
+  def _write_records(self, path, n=12):
+    from tensor2robot_tpu.data import tfrecord, wire
+    from tensor2robot_tpu.utils.image import numpy_to_image_string
+    rng = np.random.RandomState(0)
+    frames, records = [], []
+    for i in range(n):
+      img = np.tile(rng.randint(0, 255, (64, 64, 1), np.uint8), (1, 1, 3))
+      frames.append(img)
+      records.append(wire.build_example({
+          'frame': numpy_to_image_string(img),
+          'target': np.asarray([float(i % 2)], np.float32)}))
+    tfrecord.write_records(path, records)
+    return frames
+
+  def test_specs_and_parity_with_host_decode(self, tmp_path):
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    model = self._image_model()
+    path = str(tmp_path / 'imgs.tfrecord')
+    frames = self._write_records(path)
+    model.set_preprocessor(DeviceDecodePreprocessor(model.preprocessor))
+    in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+    assert 'image/y' in dict(in_spec) and 'image/qt' in dict(in_spec)
+    assert tuple(in_spec['image/y'].shape) == (8, 8, 64)
+
+    generator = DefaultRecordInputGenerator(file_patterns=path,
+                                            batch_size=4)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(generator.create_dataset_iterator(
+        mode=ModeKeys.EVAL, num_epochs=1))
+    # Finish the decode exactly as the jitted step would.
+    decoded, _ = model.preprocessor.preprocess(features, labels,
+                                               ModeKeys.EVAL)
+    img = np.asarray(decoded['image'])
+    assert img.shape == (4, 64, 64, 3) and img.dtype == np.uint8
+    # Pixel parity vs a host decode of the same JPEG bytes (first record
+    # of the unshuffled EVAL stream).
+    from tensor2robot_tpu.utils.image import (
+        image_string_to_numpy,
+        numpy_to_image_string,
+    )
+    host = image_string_to_numpy(numpy_to_image_string(frames[0]))
+    diff = img[0].astype(int) - host.astype(int)
+    assert np.abs(diff).max() <= 4
+
+  def test_trains_from_coef_records(self, tmp_path):
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    from tensor2robot_tpu.trainer import Trainer
+    model = self._image_model()
+    path = str(tmp_path / 'imgs.tfrecord')
+    self._write_records(path)
+    model.set_preprocessor(DeviceDecodePreprocessor(model.preprocessor))
+    generator = DefaultRecordInputGenerator(file_patterns=path,
+                                            batch_size=4)
+    trainer = Trainer(model, str(tmp_path / 'run'),
+                      mesh=parallel.create_mesh(
+                          {'data': 1}, devices=jax.devices()[:1]),
+                      async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    try:
+      state = trainer.train(generator, max_train_steps=2,
+                            shard_index=0, num_shards=1)
+      assert int(jax.device_get(state.step)) == 2
+    finally:
+      trainer.close()
+
+  def test_requires_eligible_image_spec(self):
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    from tensor2robot_tpu.preprocessors.noop_preprocessor import (
+        NoOpPreprocessor,
+    )
+    pre = NoOpPreprocessor(
+        lambda mode: SpecStruct(x=TensorSpec((4,), np.float32, name='x')),
+        lambda mode: SpecStruct())
+    with pytest.raises(ValueError, match='no coef-eligible'):
+      DeviceDecodePreprocessor(pre)

@@ -274,6 +274,52 @@ class TestSeq2ActModel:
       trainer.close()
 
 
+class TestServingPolicy:
+  """Robot-time serving: rolling frame window through the sequential
+  policy (the deployment loop of a seq-to-action BC policy)."""
+
+  def test_pack_features_rolls_window(self):
+    model = Seq2ActBCModel(**TINY)
+    frame0 = np.zeros((36, 36, 3), np.uint8)
+    frame1 = np.full((36, 36, 3), 50, np.uint8)
+    first = model.pack_features({'image': frame0}, None, 0)
+    assert first['image'].shape == (1, 4, 36, 36, 3)
+    assert np.all(first['image'] == 0)
+    second = model.pack_features({'image': frame1}, first, 1)
+    assert np.all(second['image'][0, -1] == 50)
+    assert np.all(second['image'][0, :-1] == 0)
+
+  def test_sequential_policy_serves_actions(self, tmp_path):
+    from tensor2robot_tpu.policies import SequentialRegressionPolicy
+
+    model = Seq2ActBCModel(**TINY)
+    rng = np.random.RandomState(2)
+    generator = GeneratorInputGenerator(
+        batch_generator_fn=lambda b: _episode_batch(rng, b), batch_size=8)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    trainer = Trainer(model, str(tmp_path), async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    try:
+      trainer.train(generator, max_train_steps=1)
+    finally:
+      trainer.close()
+    serving_model = Seq2ActBCModel(**TINY)
+    predictor = CheckpointPredictor(serving_model, str(tmp_path),
+                                    timeout=5.0)
+    assert predictor.restore()
+    policy = SequentialRegressionPolicy(t2r_model=serving_model,
+                                        predictor=predictor)
+    policy.reset()
+    for step in range(4):
+      frame = np.full((36, 36, 3), step * 40, np.uint8)
+      action = policy.SelectAction({'image': frame}, None, step)
+      action = np.asarray(action)
+      assert action.shape == (TINY['action_size'],)
+      assert np.all(np.isfinite(action))
+      assert np.all(np.abs(action) <= 1.0)
+    predictor.close()
+
+
 class TestConfig:
 
   def test_gin_config_parses_and_builds_model(self):
