@@ -131,6 +131,9 @@ class AbstractInputGenerator(abc.ABC):
   def set_specification(self, feature_spec, label_spec) -> None:
     self._feature_spec = specs_lib.flatten_spec_structure(feature_spec)
     self._label_spec = specs_lib.flatten_spec_structure(label_spec)
+    # Plain specs: clear any device-decode plan a previous
+    # set_specification_from_model(wrapped_model) installed.
+    self._raw_feature_spec = None
 
   @property
   def feature_spec(self):

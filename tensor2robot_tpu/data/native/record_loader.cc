@@ -259,7 +259,10 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
         cfg->buffer_sizes.push_back(B * f.count * f.dtype_size);
         break;
       case kImageFull: {
-        long long frames = f.count > 1 ? f.count : 1;
+        // count > 0: a rank-4 [T, H, W, C] spec — strict frame count
+        // (even T=1). count == 0: rank-3 single image, first bytes
+        // element wins (Python parser parity).
+        long long frames = f.count > 0 ? f.count : 1;
         f.buf0 = (int)cfg->buffer_sizes.size();
         cfg->buffer_sizes.push_back(B * frames * (long long)f.h * f.w *
                                     f.c);
@@ -721,17 +724,17 @@ struct Loader {
         case 1: {  // BytesList
           if (f.kind != kImageFull && f.kind != kImageCoef)
             return "feature '" + f.name + "' is bytes but spec is numeric";
-          long long frames = (f.kind == kImageFull && f.count > 1)
-                                 ? f.count : 1;
+          bool strict_list = f.kind == kImageFull && f.count > 0;
+          long long frames = strict_list ? f.count : 1;
           long long got = 0;
           uint32_t wt2;
           while (uint32_t f2 = list.tag(&wt2)) {
             if (f2 == 1 && wt2 == 2) {
               Cursor payload = list.bytes();
               if (got >= frames) {
-                if (frames == 1) continue;  // rank-3 spec: first element
-                                            // wins, extras ignored
-                                            // (Python parser parity)
+                if (!strict_list) continue;  // rank-3 spec: first element
+                                             // wins, extras ignored
+                                             // (Python parser parity)
                 char buf[128];
                 snprintf(buf, sizeof buf, "feature '%s': more than %lld "
                          "encoded frames", f.name.c_str(), frames);
@@ -758,7 +761,7 @@ struct Loader {
             }
             list.skip(wt2);
           }
-          if (f.kind == kImageFull && got != frames) {
+          if (strict_list && got != frames) {
             char buf[128];
             snprintf(buf, sizeof buf, "feature '%s': got %lld encoded "
                      "frames, want %lld", f.name.c_str(), got, frames);

@@ -128,6 +128,21 @@ class NativeLoaderPlan:
     self.label_spec = label_spec
 
 
+def coef_eligible(spec: TensorSpec) -> bool:
+  """Can this image spec ship as DCT coefficients (split decode)?
+
+  Baseline 4:2:0 constraints: rank-3 uint8 3-channel JPEG with both
+  spatial dims divisible by 16. The ONE authority for coef eligibility —
+  plan_for_specs and DeviceDecodePreprocessor both consult it.
+  """
+  shape = tuple(spec.shape or ())
+  return (spec.is_encoded_image
+          and spec.data_format in (None, 'jpeg', 'JPEG', 'jpg')
+          and len(shape) == 3 and shape[-1] == 3
+          and spec.dtype == np.uint8
+          and shape[0] % 16 == 0 and shape[1] % 16 == 0)
+
+
 def plan_for_specs(feature_spec, label_spec,
                    image_mode: str = 'full') -> Optional[NativeLoaderPlan]:
   """Returns a plan if the native fast path supports these specs, else None.
@@ -165,11 +180,8 @@ def plan_for_specs(feature_spec, label_spec,
             or shape[-1] not in (1, 3):
           return None
         if image_mode == 'coef':
-          if len(shape) != 4 and (shape[0] % 16 or shape[1] % 16
-                                  or shape[-1] != 3):
-            return None
-          if len(shape) == 4:
-            return None  # coef mode: single-frame specs only
+          if not coef_eligible(spec):
+            return None  # incl. rank-4: coef mode is single-frame only
           fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
                                np.int16))
         else:

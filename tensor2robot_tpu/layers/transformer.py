@@ -61,18 +61,6 @@ def scaled_dot_attention(q, k, v, causal: bool) -> jnp.ndarray:
                     ).astype(q.dtype)
 
 
-def _flash_blocks(l: int):
-  """Largest preferred block sizes dividing L (kernel divisibility rule).
-
-  (256, 1024) are the v5e sweep winners; non-dividing lengths step down
-  so explicit flash mode works for ANY L (at reduced block efficiency).
-  """
-  bq = next((b for b in (256, 128, 64, 32, 16, 8) if l % b == 0), l)
-  bk = next((b for b in (1024, 512, 256, 128, 64, 32, 16, 8)
-             if l % b == 0), l)
-  return bq, bk
-
-
 def run_attention(q, k, v, *, mode: str, causal: bool,
                   mesh=None, seq_axis: str = 'data') -> jnp.ndarray:
   """Dispatches [B, L, H, D] self-attention to the selected backend."""
@@ -80,15 +68,15 @@ def run_attention(q, k, v, *, mode: str, causal: bool,
   if mode == 'auto':
     on_tpu = jax.default_backend() == 'tpu'
     # Lengths with poor block divisibility fall back to dense rather
-    # than running the kernel with tiny blocks.
+    # than running the kernel with tiny blocks (the kernel itself steps
+    # its blocks down to dividing sizes, so explicit 'flash' always
+    # works — 'auto' just avoids the slow small-block regime).
     mode = 'flash' if (on_tpu and l >= _FLASH_MIN_LENGTH
                        and l % 128 == 0) else 'xla'
   if mode == 'xla':
     return scaled_dot_attention(q, k, v, causal)
   if mode == 'flash':
-    block_q, block_k = _flash_blocks(l)
-    return flash_lib.flash_attention(q, k, v, causal=causal,
-                                     block_q=block_q, block_k=block_k)
+    return flash_lib.flash_attention(q, k, v, causal=causal)
   if mode == 'ring':
     if mesh is None:
       raise ValueError("attention_mode='ring' requires a mesh.")

@@ -342,9 +342,19 @@ def flash_attention(q, k, v,
     interpret = jax.default_backend() == 'cpu'
   b, l_q, h, d = q.shape
   l_k = k.shape[1]
-  block_q = min(block_q, l_q)
-  block_k = min(block_k, l_k)
-  if l_q % block_q or l_k % block_k:
+
+  def _dividing_block(requested, l):
+    """Largest block <= requested that divides L (stepping down through
+    the power-of-two ladder), so any L works at reduced block efficiency
+    instead of raising."""
+    for candidate in (requested, 512, 256, 128, 64, 32, 16, 8):
+      if candidate <= l and l % candidate == 0 and candidate <= requested:
+        return candidate
+    return l
+
+  block_q = _dividing_block(min(block_q, l_q), l_q)
+  block_k = _dividing_block(min(block_k, l_k), l_k)
+  if l_q % block_q or l_k % block_k:  # unreachable: l divides l
     raise ValueError(
         'Sequence lengths ({}, {}) must be multiples of the block sizes '
         '({}, {}).'.format(l_q, l_k, block_q, block_k))

@@ -25,21 +25,13 @@ from typing import List, Tuple
 import numpy as np
 
 from tensor2robot_tpu.data import jpeg_device
+from tensor2robot_tpu.data.native_loader import coef_eligible
 from tensor2robot_tpu.preprocessors.abstract_preprocessor import (
     AbstractPreprocessor,
 )
 from tensor2robot_tpu.specs import algebra
 from tensor2robot_tpu.specs.struct import SpecStruct
 from tensor2robot_tpu.specs.tensor_spec import TensorSpec
-
-
-def _coef_eligible(spec: TensorSpec) -> bool:
-  shape = tuple(spec.shape or ())
-  return (spec.is_encoded_image
-          and spec.data_format in (None, 'jpeg', 'JPEG', 'jpg')
-          and len(shape) == 3 and shape[-1] == 3
-          and spec.dtype == np.uint8
-          and shape[0] % 16 == 0 and shape[1] % 16 == 0)
 
 
 def coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
@@ -69,6 +61,21 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
       raise ValueError(
           'DeviceDecodePreprocessor: the wrapped preprocessor declares no '
           'coef-eligible image specs (rank-3 uint8 JPEG, dims % 16 == 0).')
+    # Fail at wrap time, naming the offenders: the coef record loader
+    # rejects a plan containing ANY non-eligible encoded image, so a
+    # mixed spec set would otherwise surface as a late, generic error at
+    # iterator creation.
+    spec = algebra.flatten_spec_structure(
+        self._inner.get_in_feature_specification('train'))
+    ineligible = [key for key in spec
+                  if spec[key].is_encoded_image
+                  and not coef_eligible(spec[key])]
+    if ineligible:
+      raise ValueError(
+          'DeviceDecodePreprocessor: encoded-image specs {} are not '
+          'coef-eligible (need rank-3 uint8 3-channel JPEG with dims '
+          'divisible by 16); split decode requires ALL images eligible.'
+          .format(ineligible))
 
   @property
   def inner(self) -> AbstractPreprocessor:
@@ -77,7 +84,7 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
   def image_keys(self, mode: str) -> List[str]:
     spec = algebra.flatten_spec_structure(
         self._inner.get_in_feature_specification(mode))
-    return [key for key in spec if _coef_eligible(spec[key])]
+    return [key for key in spec if coef_eligible(spec[key])]
 
   def raw_in_feature_specification(self, mode: str) -> SpecStruct:
     """The inner (on-disk JPEG) in-specs — what the record loader plans."""
@@ -88,7 +95,7 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
         self._inner.get_in_feature_specification(mode))
     out = SpecStruct()
     for key in spec:
-      if _coef_eligible(spec[key]):
+      if coef_eligible(spec[key]):
         for ckey, cspec in coef_specs(key, spec[key]).items():
           out[ckey] = cspec
       else:
