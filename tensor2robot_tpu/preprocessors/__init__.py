@@ -11,3 +11,6 @@ from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
     Bfloat16PreprocessorWrapper,
 )
 from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors.device_decode import (
+    DeviceDecodePreprocessor,
+)
