@@ -135,6 +135,7 @@ class RT1StyleNet(nn.Module):
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
   use_state_input: bool = False
+  num_task_embeddings: int = 0
 
   @nn.compact
   def __call__(self, features, mode: str = ModeKeys.TRAIN,
@@ -150,6 +151,23 @@ class RT1StyleNet(nn.Module):
 
     tokens = multi_batch_apply(_tokenize, 2, images)    # [B, T, K, D]
     k = tokens.shape[2]
+    if self.num_task_embeddings:
+      # Task conditioning (RT-1's instruction-conditioning analog at the
+      # scale this environment permits): a learned per-task embedding
+      # token joins every frame's token group.
+      # Clamp explicitly: under jit an out-of-range id cannot raise, and
+      # relying on the gather's implicit clamp would hide the policy.
+      # Host-side entry points (pack_features) validate the range eagerly.
+      task_id = jnp.clip(
+          jnp.asarray(features['task_id'], jnp.int32).reshape(b), 0,
+          self.num_task_embeddings - 1)
+      task_token = nn.Embed(self.num_task_embeddings, self.embed_dim,
+                            dtype=self.dtype, name='task_embedding')(
+                                task_id)                  # [B, D]
+      task_token = jnp.broadcast_to(task_token[:, None, None, :],
+                                    (b, t, 1, self.embed_dim))
+      tokens = jnp.concatenate([tokens, task_token], axis=2)
+      k += 1
     if self.use_state_input:
       state = jnp.asarray(features['state'], self.dtype)  # [B, T, S]
       state_token = nn.Dense(self.embed_dim, dtype=self.dtype,
@@ -198,6 +216,7 @@ class Seq2ActBCModel(AbstractT2RModel):
                dropout_rate: float = 0.0,
                use_state_input: bool = False,
                state_size: int = 7,
+               num_task_embeddings: int = 0,
                learning_rate: float = 1e-4,
                **kwargs):
     import functools
@@ -229,6 +248,7 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._dropout_rate = dropout_rate
     self._use_state_input = use_state_input
     self._state_size = state_size
+    self._num_task_embeddings = num_task_embeddings
     self._bin_centers = decoders.get_discrete_bins(
         vocab_size, np.full((action_size,), action_min, np.float32),
         np.full((action_size,), action_max, np.float32))
@@ -246,6 +266,8 @@ class Seq2ActBCModel(AbstractT2RModel):
     if self._use_state_input:
       spec['state'] = TensorSpec(
           (self._episode_length, self._state_size), np.float32, name='state')
+    if self._num_task_embeddings:
+      spec['task_id'] = TensorSpec((1,), np.int32, name='task_id')
     return spec
 
   def get_label_specification(self, mode: str) -> SpecStruct:
@@ -269,7 +291,8 @@ class Seq2ActBCModel(AbstractT2RModel):
         mesh=self._mesh,
         dropout_rate=self._dropout_rate,
         dtype=self.compute_dtype,
-        use_state_input=self._use_state_input)
+        use_state_input=self._use_state_input,
+        num_task_embeddings=self._num_task_embeddings)
 
   def model_train_fn(self, variables, features, labels, inference_outputs,
                      mode: str):
@@ -309,7 +332,19 @@ class Seq2ActBCModel(AbstractT2RModel):
     else:
       prev = np.asarray(context['image'])
       window = np.concatenate([prev[:, 1:], frame], axis=1)
-    return {'image': window}
+    packed = {'image': window}
+    if self._num_task_embeddings:
+      if 'task_id' not in state:
+        raise ValueError(
+            'Task-conditioned model (num_task_embeddings={}) requires a '
+            "'task_id' in the observation.".format(
+                self._num_task_embeddings))
+      task_id = int(np.asarray(state['task_id']).reshape(()))
+      if not 0 <= task_id < self._num_task_embeddings:
+        raise ValueError('task_id {} out of range [0, {}).'.format(
+            task_id, self._num_task_embeddings))
+      packed['task_id'] = np.asarray([[task_id]], np.int32)
+    return packed
 
   def create_export_outputs_fn(self, features, inference_outputs, mode: str
                                ) -> SpecStruct:

@@ -274,6 +274,72 @@ class TestSeq2ActModel:
       trainer.close()
 
 
+class TestTaskConditioning:
+  """RT-1-style task conditioning: a learned task-embedding token."""
+
+  def _batch(self, rng, batch_size):
+    v = rng.rand(batch_size, 4).astype(np.float32)
+    frames = np.broadcast_to(
+        (v * 255).astype(np.uint8)[:, :, None, None, None],
+        (batch_size, 4, 36, 36, 3)).copy()
+    task = rng.randint(0, 2, (batch_size, 1)).astype(np.int32)
+    sign = np.where(task == 0, 1.0, -1.0).astype(np.float32)  # [B, 1]
+    action = np.stack([(2 * v - 1) * sign, (2 * v - 1) * sign], axis=-1)
+    return ({'image': frames, 'task_id': task},
+            {'action': action.astype(np.float32)})
+
+  def test_specs_and_shapes(self):
+    model = Seq2ActBCModel(num_task_embeddings=4, **TINY)
+    spec = model.get_feature_specification(ModeKeys.TRAIN)
+    assert 'task_id' in dict(spec)
+    generator = DefaultRandomInputGenerator(batch_size=2)
+    generator.set_specification_from_model(model, ModeKeys.PREDICT)
+    features, _ = next(
+        generator.create_dataset_iterator(mode=ModeKeys.PREDICT, seed=0))
+    features, _ = model.preprocessor.preprocess(
+        features, None, ModeKeys.PREDICT)
+    variables = model.init_variables(jax.random.PRNGKey(0), features,
+                                     mode=ModeKeys.PREDICT)
+    assert 'task_embedding' in variables['params']
+    outputs, _ = model.inference_network_fn(variables, features,
+                                            mode=ModeKeys.PREDICT)
+    assert np.asarray(outputs['action_logits']).shape == (
+        2, 4, TINY['action_size'] * TINY['vocab_size'])
+
+  def test_learns_task_dependent_rule(self):
+    """The SAME image demands OPPOSITE actions depending on task_id —
+    unsolvable without the conditioning token (chance ~6%)."""
+    from tensor2robot_tpu.research.vrgripper import decoders
+    from tensor2robot_tpu.specs.struct import SpecStruct
+
+    model = Seq2ActBCModel(num_task_embeddings=2, learning_rate=3e-3,
+                           **TINY)
+    rng = np.random.RandomState(0)
+    f, l = self._batch(rng, 16)
+    feats, labs = model.preprocessor.preprocess(
+        SpecStruct(**f), SpecStruct(**l), ModeKeys.TRAIN,
+        rng=jax.random.PRNGKey(0))
+    state = model.create_train_state(jax.random.PRNGKey(1), feats, labs)
+    step = jax.jit(model.train_step)
+    for i in range(300):
+      f, l = self._batch(rng, 16)
+      feats, labs = model.preprocessor.preprocess(
+          SpecStruct(**f), SpecStruct(**l), ModeKeys.TRAIN,
+          rng=jax.random.PRNGKey(i))
+      state, metrics = step(state, feats, labs, jax.random.PRNGKey(1000 + i))
+    f, l = self._batch(rng, 64)
+    feats, _ = model.preprocessor.preprocess(SpecStruct(**f), None,
+                                             ModeKeys.PREDICT)
+    out, _ = model.inference_network_fn(state.variables(), feats,
+                                        mode=ModeKeys.PREDICT)
+    pred = np.asarray(decoders.get_discrete_actions(
+        out['action_logits'], 2, TINY['vocab_size'], model._bin_centers))
+    err = np.abs(pred - l['action'])
+    half_bin = 2.0 / TINY['vocab_size'] / 2 + 1e-6
+    acc = (err <= half_bin).mean()
+    assert acc > 0.3, acc  # chance ~0.06; sign flips require task_id
+
+
 class TestServingPolicy:
   """Robot-time serving: rolling frame window through the sequential
   policy (the deployment loop of a seq-to-action BC policy)."""
@@ -288,6 +354,17 @@ class TestServingPolicy:
     second = model.pack_features({'image': frame1}, first, 1)
     assert np.all(second['image'][0, -1] == 50)
     assert np.all(second['image'][0, :-1] == 0)
+
+  def test_pack_features_task_conditioned(self):
+    model = Seq2ActBCModel(num_task_embeddings=3, **TINY)
+    frame = np.zeros((36, 36, 3), np.uint8)
+    packed = model.pack_features({'image': frame, 'task_id': 2}, None, 0)
+    assert packed['task_id'].shape == (1, 1)
+    assert int(packed['task_id'][0, 0]) == 2
+    with pytest.raises(ValueError, match='task_id'):
+      model.pack_features({'image': frame}, None, 0)
+    with pytest.raises(ValueError, match='out of range'):
+      model.pack_features({'image': frame, 'task_id': 7}, None, 0)
 
   def test_sequential_policy_serves_actions(self, tmp_path):
     from tensor2robot_tpu.policies import SequentialRegressionPolicy
