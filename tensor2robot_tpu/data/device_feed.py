@@ -1,0 +1,89 @@
+"""Sparse-aware host->device batch feed.
+
+The last hop of the split-decode input path (SURVEY hard-part #3). A
+``DeviceDecodePreprocessor(sparse=True)`` pipeline ships images as sparse
+DCT entry streams (``key/{sd,sv,qt,n}``, data/native/record_loader.cc) whose
+second dim is BUCKETED per batch — the format's transfer savings come from
+slicing buffers to the batch's actual entry count. Unpacking them inside the
+jitted train step would therefore recompile the whole model per bucket;
+instead this feed converts sparse groups to the fixed-shape dense
+coefficient tensors (``key/{y,cb,cr}``) the preprocessor consumes, in a
+SEPARATE tiny jit cached per (batch, bucket) shape, right after the
+host->device transfer:
+
+    host batch (sparse, ~8x fewer bytes) --transfer--> device
+      --unpack jit (cumsum + scatter-add, ~15 ms / 64 frames)-->
+    dense coef batch --train step (shape-stable, never recompiles)-->
+
+Non-sparse batches pass through as a plain ``shard_batch``, so the Trainer
+routes every batch through :meth:`SparseCoefFeed.put_batch` unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from tensor2robot_tpu.data import jpeg_device
+from tensor2robot_tpu.parallel import sharding as sharding_lib
+
+
+class SparseCoefFeed:
+  """Converts host batches with sparse coef groups into device batches."""
+
+  def __init__(self, image_shapes: Dict[str, Tuple[int, int]], mesh):
+    self._shapes = dict(image_shapes)
+    self._mesh = mesh
+    self._jit_cache = {}
+
+  @classmethod
+  def from_preprocessor(cls, preprocessor, mesh
+                        ) -> Optional['SparseCoefFeed']:
+    """A feed for a DeviceDecodePreprocessor-wrapped model, else None."""
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+
+    if not isinstance(preprocessor, DeviceDecodePreprocessor):
+      return None
+    spec = preprocessor.raw_in_feature_specification('train')
+    from tensor2robot_tpu.specs import algebra
+    flat = algebra.flatten_spec_structure(spec)
+    shapes = {key: (flat[key].shape[0], flat[key].shape[1])
+              for key in preprocessor.image_keys('train')}
+    return cls(shapes, mesh=mesh)
+
+  def _unpack_fn(self, height: int, width: int, shape):
+    import jax
+
+    cache_key = (height, width, tuple(shape))
+    fn = self._jit_cache.get(cache_key)
+    if fn is None:
+      # No donation: the uint8/int8 inputs can't alias the int16 outputs,
+      # so donating only produces "donated buffers were not usable" spam.
+      fn = jax.jit(
+          lambda sd, sv: jpeg_device.unpack_sparse_coefficients(
+              sd, sv, height, width))
+      self._jit_cache[cache_key] = fn
+    return fn
+
+  def put_batch(self, batch: dict) -> dict:
+    """shard_batch + on-device sparse->dense coef unpack where present."""
+    device = sharding_lib.shard_batch(batch, self._mesh)
+    features = device.get('features')
+    if not features or not any(
+        key + '/sd' in features for key in self._shapes):
+      return device
+    features = dict(features)
+    for key, (height, width) in self._shapes.items():
+      if key + '/sd' not in features:
+        continue
+      sd = features.pop(key + '/sd')
+      sv = features.pop(key + '/sv')
+      features.pop(key + '/n', None)
+      y, cb, cr = self._unpack_fn(height, width, sd.shape)(sd, sv)
+      features[key + '/y'] = y
+      features[key + '/cb'] = cb
+      features[key + '/cr'] = cr
+    device = dict(device)
+    device['features'] = features
+    return device

@@ -96,6 +96,7 @@ class AbstractInputGenerator(abc.ABC):
     self._feature_spec = None
     self._label_spec = None
     self._raw_feature_spec = None  # device-decode: on-disk JPEG specs
+    self._device_decode_preprocessor = None
     self._preprocess_fn = None
 
   @property
@@ -124,9 +125,11 @@ class AbstractInputGenerator(abc.ABC):
     specs_lib.assert_valid_spec_structure(self._feature_spec)
     specs_lib.assert_valid_spec_structure(self._label_spec)
     self._raw_feature_spec = None
+    self._device_decode_preprocessor = None
     if hasattr(preprocessor, 'raw_in_feature_specification'):
       self._raw_feature_spec = preprocessor.raw_in_feature_specification(
           mode)
+      self._device_decode_preprocessor = preprocessor
 
   def set_specification(self, feature_spec, label_spec) -> None:
     self._feature_spec = specs_lib.flatten_spec_structure(feature_spec)
@@ -134,6 +137,7 @@ class AbstractInputGenerator(abc.ABC):
     # Plain specs: clear any device-decode plan a previous
     # set_specification_from_model(wrapped_model) installed.
     self._raw_feature_spec = None
+    self._device_decode_preprocessor = None
 
   @property
   def feature_spec(self):
@@ -233,8 +237,11 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       if self._dataset_map is not None:
         raise ValueError(
             'DeviceDecodePreprocessor does not support multi-dataset zip.')
+      sparse = bool(getattr(self._device_decode_preprocessor, 'sparse',
+                            False))
       plan = native_loader.plan_for_specs(
-          self._raw_feature_spec, self._label_spec, image_mode='coef')
+          self._raw_feature_spec, self._label_spec,
+          image_mode='coef_sparse' if sparse else 'coef')
       if plan is None:
         raise ValueError(
             'DeviceDecodePreprocessor requires the native loader fast path '
@@ -246,12 +253,17 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
             'Host {} of {} has no record files for the device-decode '
             'stream; provide at least num_shards files.'.format(
                 shard_index, num_shards))
+      import jax
+
       stream = native_loader.NativeBatchedStream(
           plan, files, batch_size=self._batch_size,
           shuffle=(mode == ModeKeys.TRAIN),
           shuffle_buffer=self._shuffle_buffer_size,
           num_epochs=num_epochs, seed=seed,
-          num_threads=self._num_native_threads, validate=False)
+          num_threads=self._num_native_threads, validate=False,
+          # Per-host buckets diverge across processes; multi-host SPMD
+          # needs the host-invariant full-capacity shape.
+          bucket_sparse=jax.process_count() == 1)
       return iter(stream)
     if self._use_native is False or not native_loader.native_loader_enabled():
       return None

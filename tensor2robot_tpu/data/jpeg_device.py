@@ -125,6 +125,65 @@ def decode_jpeg_coefficients(y: jnp.ndarray, cb: jnp.ndarray,
   return rgb.astype(dtype)
 
 
+def unpack_sparse_coefficients(sd: jnp.ndarray, sv: jnp.ndarray,
+                               height: int, width: int):
+  """Sparse (delta, value) entry streams -> dense coefficient planes.
+
+  Inverse of the native loader's ``image_mode='coef_sparse'`` packing
+  (record_loader.cc, decode_jpeg_coef_sparse): each entry advances a
+  cursor through the unified flat coefficient space [y | cb | cr] by
+  ``sd`` positions and adds ``sv`` there. Skip entries (255, 0),
+  value-continuation entries (0, piece) and tail padding (0, 0) all fall
+  out of the same cumsum + scatter-add — measured ~15 ms for a 64-frame
+  512x640 batch on one v5e (4,270 frames/s), ~17x the post-compression
+  transfer rate it serves.
+
+  Args:
+    sd: [B, C] uint8 position deltas.
+    sv: [B, C] int8 value pieces.
+    height, width: frame geometry (divisible by 16).
+
+  Returns: (y, cb, cr) int16 dense blocks shaped like the 'coef' mode
+  outputs ([B, H/8, W/8, 64], [B, H/16, W/16, 64] x2, natural order).
+  """
+  b, _ = sd.shape
+  yb = (height // 8) * (width // 8)
+  cbn = (height // 16) * (width // 16)
+  total = (yb + 2 * cbn) * 64
+  pos = jnp.cumsum(sd.astype(jnp.int32), axis=1) - 1
+  # Rows with zero entries keep the cursor at -1; jnp negative indices
+  # WRAP, so route them out of bounds for mode='drop' instead.
+  pos = jnp.where(pos < 0, total, pos)
+  dense = jnp.zeros((b, total), jnp.int16)
+  dense = dense.at[jnp.arange(b)[:, None], pos].add(
+      sv.astype(jnp.int16), mode='drop')
+  y = dense[:, :yb * 64].reshape(b, height // 8, width // 8, 64)
+  cb = dense[:, yb * 64:(yb + cbn) * 64].reshape(
+      b, height // 16, width // 16, 64)
+  cr = dense[:, (yb + cbn) * 64:].reshape(b, height // 16, width // 16, 64)
+  return y, cb, cr
+
+
+def unpack_sparse_features(features, image_shapes):
+  """Replaces ``key/{sd,sv}`` sparse groups with dense ``key/{y,cb,cr}``.
+
+  ``image_shapes`` maps image key -> (height, width). The ``key/qt``
+  tables pass through unchanged and ``key/n`` entry counts are dropped,
+  leaving exactly the 'coef' mode feature set decode_coef_features
+  consumes. Jittable; callers cache one jit per (batch, bucket) shape
+  (data/device_feed.py) so the train step itself never recompiles.
+  """
+  for key, (height, width) in image_shapes.items():
+    sd = features.pop(key + '/sd')
+    sv = features.pop(key + '/sv')
+    features.pop(key + '/n', None)
+    y, cb, cr = unpack_sparse_coefficients(sd, sv, height, width)
+    features[key + '/y'] = y
+    features[key + '/cb'] = cb
+    features[key + '/cr'] = cr
+  return features
+
+
 def decode_coef_features(features, image_keys, dtype=jnp.uint8):
   """Replaces ``key/{y,cb,cr,qt}`` coefficient groups with decoded ``key``.
 

@@ -171,7 +171,13 @@ struct Cursor {
 // Config.
 // ---------------------------------------------------------------------------
 
-enum FieldKind { kFloat = 0, kInt = 1, kImageFull = 2, kImageCoef = 3 };
+enum FieldKind {
+  kFloat = 0,
+  kInt = 1,
+  kImageFull = 2,
+  kImageCoef = 3,
+  kImageCoefSparse = 4,
+};
 
 struct FieldSpec {
   std::string name;
@@ -180,11 +186,15 @@ struct FieldSpec {
   int h = 0, w = 0, c = 0;  // image fields
   // float/int fields: elements per row. image_full fields: number of
   // frames (a rank-4 [T, H, W, C] spec stores T JPEGs as a bytes list;
-  // 0/1 means a single [H, W, C] image).
+  // 0/1 means a single [H, W, C] image). image_coef_sparse fields: the
+  // per-row entry capacity of the sparse (delta, value) streams.
   long long count = 0;
   // Buffer indices into Slot::buffers (filled at config time).
-  int buf0 = -1;            // primary (float/int/u8 pixels, or coef Y)
-  int buf_cb = -1, buf_cr = -1, buf_qt = -1;  // image_coef extras
+  int buf0 = -1;            // primary (float/int/u8 pixels, coef Y, or
+                            // sparse deltas)
+  int buf_cb = -1, buf_cr = -1, buf_qt = -1;  // image_coef extras; sparse
+                            // mode reuses buf_cb for values
+  int buf_n = -1;           // image_coef_sparse: per-row entry counts
 };
 
 struct Config {
@@ -283,6 +293,27 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
         cfg->buffer_sizes.push_back(B * cblocks * 64 * 2);
         f.buf_qt = (int)cfg->buffer_sizes.size();
         cfg->buffer_sizes.push_back(B * 3 * 64 * 2);
+        break;
+      }
+      case kImageCoefSparse: {
+        if (f.h % 16 || f.w % 16 || f.c != 3) {
+          *err = "image_coef_sparse requires HxW multiple of 16 and c=3: " +
+                 f.name;
+          return false;
+        }
+        if (f.count <= 0) {
+          *err = "image_coef_sparse requires a positive entry capacity: " +
+                 f.name;
+          return false;
+        }
+        f.buf0 = (int)cfg->buffer_sizes.size();        // deltas, uint8
+        cfg->buffer_sizes.push_back(B * f.count);
+        f.buf_cb = (int)cfg->buffer_sizes.size();      // values, int8
+        cfg->buffer_sizes.push_back(B * f.count);
+        f.buf_qt = (int)cfg->buffer_sizes.size();      // quant tables
+        cfg->buffer_sizes.push_back(B * 3 * 64 * 2);
+        f.buf_n = (int)cfg->buffer_sizes.size();       // entry counts, int32
+        cfg->buffer_sizes.push_back(B * 4);
         break;
       }
     }
@@ -417,6 +448,136 @@ std::string decode_jpeg_coef(const uint8_t* data, size_t n,
   }
   jpeg_finish_decompress(&cinfo);
   jpeg_destroy_decompress(&cinfo);
+  return "";
+}
+
+// Entropy decode + sparse packing: the quantized DCT coefficients of a
+// camera JPEG are overwhelmingly zero (measured ~12% nonzero on realistic
+// 512x640 frames), so shipping them dense to the device wastes ~8x the
+// bytes on a bandwidth-limited host->device link. This mode emits a
+// (delta, value) entry stream per image over a unified flat coefficient
+// space [y blocks | cb blocks | cr blocks] in block-row-major natural
+// order:
+//
+//   entry (d, v): advance the cursor by d positions, then ADD v at the
+//   cursor. d is uint8, v is int8. Long zero gaps become (255, 0) skip
+//   entries; values outside int8 become (0, piece) continuation entries
+//   that add onto the same position; buffer tail padding is (0, 0),
+//   a no-op. The device reconstructs with one cumsum + one scatter-add
+//   (data/jpeg_device.py, unpack_sparse_coefficients) — every entry kind
+//   including padding is handled by the same two ops, no branches.
+//
+// ~2 bytes per nonzero coefficient vs 2 bytes per coefficient dense.
+std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
+                                    const FieldSpec& f, uint8_t* sd,
+                                    int8_t* sv, uint16_t* qt,
+                                    int32_t* count_out) {
+  const long long cap = f.count;
+  if (n == 0) {  // empty payload -> all-zero image (tfdata.py:444 parity)
+    memset(sd, 0, cap);
+    memset(sv, 0, cap);
+    for (int i = 0; i < 3 * 64; i++) qt[i] = 1;
+    *count_out = 0;
+    return "";
+  }
+  jpeg_decompress_struct cinfo;
+  JerrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jerr_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return std::string("jpeg: ") + jerr.msg;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, n);
+  jpeg_read_header(&cinfo, TRUE);
+  jvirt_barray_ptr* coefs = jpeg_read_coefficients(&cinfo);
+  if (cinfo.num_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef_sparse: not a 3-component JPEG: " + f.name;
+  }
+  if ((int)cinfo.image_width != f.w || (int)cinfo.image_height != f.h) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef_sparse: dims mismatch for " + f.name;
+  }
+  jpeg_component_info* ci = cinfo.comp_info;
+  if (ci[0].h_samp_factor != 2 || ci[0].v_samp_factor != 2 ||
+      ci[1].h_samp_factor != 1 || ci[1].v_samp_factor != 1 ||
+      ci[2].h_samp_factor != 1 || ci[2].v_samp_factor != 1) {
+    jpeg_destroy_decompress(&cinfo);
+    return "image_coef_sparse: requires 4:2:0 chroma subsampling: " + f.name;
+  }
+  long long cur = -1, cnt = 0;
+  bool overflow = false;
+  auto emit = [&](long long pos, int v) {
+    long long diff = pos - cur;
+    while (diff > 255) {
+      if (cnt >= cap) { overflow = true; return; }
+      sd[cnt] = 255;
+      sv[cnt] = 0;
+      cnt++;
+      diff -= 255;
+    }
+    int piece = v < -128 ? -128 : (v > 127 ? 127 : v);
+    if (cnt >= cap) { overflow = true; return; }
+    sd[cnt] = (uint8_t)diff;
+    sv[cnt] = (int8_t)piece;
+    cnt++;
+    v -= piece;
+    while (v != 0) {  // |coef| > 127: add onto the same position
+      piece = v < -128 ? -128 : (v > 127 ? 127 : v);
+      if (cnt >= cap) { overflow = true; return; }
+      sd[cnt] = 0;
+      sv[cnt] = (int8_t)piece;
+      cnt++;
+      v -= piece;
+    }
+    cur = pos;
+  };
+  int bw[3] = {f.w / 8, f.w / 16, f.w / 16};
+  int bh[3] = {f.h / 8, f.h / 16, f.h / 16};
+  long long base = 0;
+  for (int comp = 0; comp < 3 && !overflow; comp++) {
+    JQUANT_TBL* tbl = ci[comp].quant_table
+                          ? ci[comp].quant_table
+                          : cinfo.quant_tbl_ptrs[ci[comp].quant_tbl_no];
+    if (!tbl) {
+      jpeg_destroy_decompress(&cinfo);
+      return "image_coef_sparse: missing quant table: " + f.name;
+    }
+    for (int i = 0; i < 64; i++) qt[comp * 64 + i] = tbl->quantval[i];
+    for (int br = 0; br < bh[comp] && !overflow; br++) {
+      JBLOCKARRAY rows = (*cinfo.mem->access_virt_barray)(
+          (j_common_ptr)&cinfo, coefs[comp], br, 1, FALSE);
+      for (int bc = 0; bc < bw[comp] && !overflow; bc++) {
+        const JCOEF* block = rows[0][bc];
+        long long block_base = base + ((long long)br * bw[comp] + bc) * 64;
+        for (int k = 0; k < 64; k++) {
+          if (block[k]) {
+            emit(block_base + k, block[k]);
+            if (overflow) break;
+          }
+        }
+      }
+    }
+    base += (long long)bh[comp] * bw[comp] * 64;
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  if (overflow) {
+    char buf[192];
+    snprintf(buf, sizeof buf,
+             "image_coef_sparse: entry capacity %lld exceeded for '%s' "
+             "(unusually dense JPEG); raise sparse_density or use "
+             "image_mode='coef'",
+             cap, f.name.c_str());
+    return buf;
+  }
+  // Tail padding MUST be zeroed: buffers are recycled across batches and a
+  // stale nonzero delta would silently corrupt positions on the device.
+  memset(sd + cnt, 0, cap - cnt);
+  memset(sv + cnt, 0, cap - cnt);
+  *count_out = (int32_t)cnt;
   return "";
 }
 
@@ -722,7 +883,8 @@ struct Loader {
       Cursor list = value.bytes();
       switch (fnum) {
         case 1: {  // BytesList
-          if (f.kind != kImageFull && f.kind != kImageCoef)
+          if (f.kind != kImageFull && f.kind != kImageCoef &&
+              f.kind != kImageCoefSparse)
             return "feature '" + f.name + "' is bytes but spec is numeric";
           bool strict_list = f.kind == kImageFull && f.count > 0;
           long long frames = strict_list ? f.count : 1;
@@ -750,6 +912,15 @@ struct Loader {
                 got++;
                 continue;
               }
+              if (f.kind == kImageCoefSparse)
+                return decode_jpeg_coef_sparse(
+                    payload.p, payload.size(), f,
+                    slot.buffers[f.buf0] + (long long)row * f.count,
+                    (int8_t*)slot.buffers[f.buf_cb] +
+                        (long long)row * f.count,
+                    (uint16_t*)slot.buffers[f.buf_qt] +
+                        (long long)row * 3 * 64,
+                    (int32_t*)slot.buffers[f.buf_n] + row);
               long long yb = (long long)(f.h / 8) * (f.w / 8) * 64;
               long long cb_n = (long long)(f.h / 16) * (f.w / 16) * 64;
               return decode_jpeg_coef(
@@ -864,8 +1035,19 @@ struct Loader {
       cv_space.notify_one();
       std::string err = parse_into(item.record, item.slot, item.row);
       if (!err.empty()) {
-        fail(err);
-        return;
+        // A decode/parse error on a row of the EOF-discarded partial batch
+        // (seq == -2, set by the reader under mu) is an error on data that
+        // drop_remainder semantics throw away anyway: complete the row
+        // normally so the slot recycles instead of erroring the stream.
+        bool discarded;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          discarded = slots[item.slot].seq == -2;
+        }
+        if (!discarded) {
+          fail(err);
+          return;
+        }
       }
       Slot& slot = slots[item.slot];
       if (slot.remaining.fetch_sub(1) == 1) {

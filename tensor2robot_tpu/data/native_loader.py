@@ -43,6 +43,13 @@ _KIND_FLOAT = 0
 _KIND_INT = 1
 _KIND_IMAGE_FULL = 2
 _KIND_IMAGE_COEF = 3
+_KIND_IMAGE_COEF_SPARSE = 4
+
+# Bucket granularity (entries) for sparse coefficient streams: per-batch
+# max entry counts are rounded up to a multiple of this before slicing, so
+# the device-side unpack sees few distinct shapes (bounded jit cache) while
+# transfer padding stays under ~7% at realistic densities.
+SPARSE_BUCKET = 4096
 
 
 def _so_path() -> str:
@@ -109,7 +116,8 @@ class _Field:
     # Images: last three dims are H, W, C (rank-4 specs carry a leading
     # frame count, which travels in ``count``).
     h, w, c = shape[-3:] if kind in (
-        _KIND_IMAGE_FULL, _KIND_IMAGE_COEF) else (0, 0, 0)
+        _KIND_IMAGE_FULL, _KIND_IMAGE_COEF,
+        _KIND_IMAGE_COEF_SPARSE) else (0, 0, 0)
     self.h, self.w, self.c = h, w, c
 
   def config_line(self) -> str:
@@ -143,13 +151,35 @@ def coef_eligible(spec: TensorSpec) -> bool:
           and shape[0] % 16 == 0 and shape[1] % 16 == 0)
 
 
+def total_coefficients(spec: TensorSpec) -> int:
+  """Flat DCT coefficient count of one 4:2:0 frame (y + cb + cr blocks)."""
+  h, w = spec.shape[0], spec.shape[1]
+  return ((h // 8) * (w // 8) + 2 * (h // 16) * (w // 16)) * 64
+
+
+def sparse_capacity(spec: TensorSpec, density: float) -> int:
+  """Entry capacity for a sparse coef stream at the given density budget."""
+  total = total_coefficients(spec)
+  cap = int(np.ceil(total * density / SPARSE_BUCKET)) * SPARSE_BUCKET
+  return max(cap, SPARSE_BUCKET)
+
+
 def plan_for_specs(feature_spec, label_spec,
-                   image_mode: str = 'full') -> Optional[NativeLoaderPlan]:
+                   image_mode: str = 'full',
+                   sparse_density: float = 0.5) -> Optional[NativeLoaderPlan]:
   """Returns a plan if the native fast path supports these specs, else None.
 
-  ``image_mode``: 'full' (decode to uint8 pixels) or 'coef' (entropy-only
+  ``image_mode``: 'full' (decode to uint8 pixels), 'coef' (entropy-only
   decode; device finishes via data/jpeg_device.py — requires 4:2:0 JPEGs
-  with dims divisible by 16).
+  with dims divisible by 16), or 'coef_sparse' (entropy decode + sparse
+  delta/value packing of the ~88%-zero quantized coefficients — same
+  device finish after a cumsum + scatter-add unpack, ~8x fewer bytes over
+  the host->device link; see record_loader.cc decode_jpeg_coef_sparse).
+
+  ``sparse_density``: coef_sparse only — per-image entry capacity as a
+  fraction of the total coefficient count. Realistic camera frames run
+  ~12-14% nonzero; the 0.5 default leaves 3-4x headroom (the stream
+  errors with a clear message if a pathological image overflows it).
   """
   feature_spec = specs_lib.flatten_spec_structure(feature_spec)
   label_spec = specs_lib.flatten_spec_structure(label_spec)
@@ -179,11 +209,16 @@ def plan_for_specs(feature_spec, label_spec,
         if len(shape) not in (3, 4) or spec.dtype != np.uint8 \
             or shape[-1] not in (1, 3):
           return None
-        if image_mode == 'coef':
+        if image_mode in ('coef', 'coef_sparse'):
           if not coef_eligible(spec):
             return None  # incl. rank-4: coef mode is single-frame only
-          fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
-                               np.int16))
+          if image_mode == 'coef_sparse':
+            fields.append(_Field(
+                full_key, spec, _KIND_IMAGE_COEF_SPARSE, 1, shape, np.int8,
+                count=sparse_capacity(spec, sparse_density)))
+          else:
+            fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
+                                 np.int16))
         else:
           # Rank-4 [T, H, W, C]: a fixed-length list of T encoded frames
           # (episode data, e.g. seq2act); count carries T to the C++ side.
@@ -231,11 +266,17 @@ class NativeBatchedStream:
                ring: int = 3,
                verify_crc: bool = False,
                copy: bool = True,
-               validate: bool = True):
+               validate: bool = True,
+               bucket_sparse: bool = True):
     self._plan = plan
     self._batch_size = int(batch_size)
     self._copy = copy
     self._validate = validate
+    # Multi-process SPMD callers MUST pass bucket_sparse=False: each host
+    # buckets from its OWN batch's max entry count, and divergent per-host
+    # buckets give make_array_from_process_local_data inconsistent global
+    # shapes (input_generators.py passes process_count()==1 through here).
+    self._bucket_sparse = bool(bucket_sparse)
     self._lib = _lib()
     threads = num_threads or max(1, min(16, (os.cpu_count() or 2)))
     lines = [
@@ -275,6 +316,8 @@ class NativeBatchedStream:
     for f in self._plan.fields:
       if f.kind == _KIND_IMAGE_COEF:
         layout.extend([(f, 'y'), (f, 'cb'), (f, 'cr'), (f, 'qt')])
+      elif f.kind == _KIND_IMAGE_COEF_SPARSE:
+        layout.extend([(f, 'sd'), (f, 'sv'), (f, 'qt'), (f, 'n')])
       else:
         layout.append((f, ''))
     return layout
@@ -305,6 +348,15 @@ class NativeBatchedStream:
         elif sub in ('cb', 'cr'):
           shape = (B, f.h // 16, f.w // 16, 64)
           dtype = np.int16
+        elif sub == 'sd':
+          shape = (B, f.count)
+          dtype = np.uint8
+        elif sub == 'sv':
+          shape = (B, f.count)
+          dtype = np.int8
+        elif sub == 'n':
+          shape = (B,)
+          dtype = np.int32
         else:  # qt
           shape = (B, 3, 64)
           dtype = np.uint16
@@ -323,10 +375,30 @@ class NativeBatchedStream:
 
   def _pack(self, slot: int):
     layout = self._buffer_layout()
+    # Sparse coef streams: slice the capacity-sized delta/value buffers to
+    # the batch's bucketed max entry count BEFORE they leave the loader —
+    # the whole point of the format is that the host->device transfer pays
+    # for actual entries, not capacity padding. The slice-copy makes these
+    # arrays owned regardless of the ``copy`` setting.
+    buckets: Dict[str, int] = {}
+    for buf, (f, sub) in enumerate(layout):
+      if sub == 'n':
+        if not self._bucket_sparse:
+          buckets[f.key] = int(f.count)  # full capacity: host-invariant
+          continue
+        max_n = int(self._views[slot][buf].max())
+        buckets[f.key] = max(
+            SPARSE_BUCKET,
+            -(-max_n // SPARSE_BUCKET) * SPARSE_BUCKET)
     by_key: Dict[str, np.ndarray] = {}
     for buf, (f, sub) in enumerate(layout):
       arr = self._views[slot][buf]
-      if self._copy:
+      if sub in ('sd', 'sv'):
+        # .copy(), NOT ascontiguousarray: when the bucket equals the full
+        # capacity the slice is already contiguous and ascontiguousarray
+        # would return a live VIEW into the recycled ring buffer.
+        arr = arr[:, :buckets[f.key]].copy()
+      elif self._copy:
         arr = arr.copy()
       key = f.key if not sub else f.key + '/' + sub
       if sub == '' and f.spec.dtype == bfloat16:
@@ -338,7 +410,8 @@ class NativeBatchedStream:
       side, rest = key.split('/', 1)
       (features if side == 'features' else labels)[rest] = arr
     if self._validate:
-      coef = any(f.kind == _KIND_IMAGE_COEF for f in self._plan.fields)
+      coef = any(f.kind in (_KIND_IMAGE_COEF, _KIND_IMAGE_COEF_SPARSE)
+                 for f in self._plan.fields)
       if not coef:  # coef outputs intentionally mismatch the image specs
         features = specs_lib.validate_and_pack(
             self._plan.feature_spec, features, ignore_batch=True)

@@ -49,13 +49,39 @@ def coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
   return out
 
 
-class DeviceDecodePreprocessor(AbstractPreprocessor):
-  """Wraps a preprocessor to accept coefficient inputs (module docstring)."""
+def sparse_coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
+  """The four sparse-stream tensors replacing one image spec.
 
-  def __init__(self, inner: AbstractPreprocessor):
+  The entry dim is dynamic (bucketed per batch by the native loader) and
+  declared None; the fixed-shape dense tensors the train step consumes are
+  produced by data/device_feed.py between transfer and step.
+  """
+  out = SpecStruct()
+  name = spec.name or key
+  out[key + '/sd'] = TensorSpec((None,), np.uint8, name=name + '/sd')
+  out[key + '/sv'] = TensorSpec((None,), np.int8, name=name + '/sv')
+  out[key + '/qt'] = TensorSpec((3, 64), np.uint16, name=name + '/qt')
+  out[key + '/n'] = TensorSpec((), np.int32, name=name + '/n')
+  return out
+
+
+class DeviceDecodePreprocessor(AbstractPreprocessor):
+  """Wraps a preprocessor to accept coefficient inputs (module docstring).
+
+  ``sparse=True`` additionally ships the coefficients as sparse
+  delta/value entry streams (~8x fewer host->device bytes on realistic
+  camera frames; data/native/record_loader.cc decode_jpeg_coef_sparse).
+  The Trainer unpacks them to dense coefficient tensors right after
+  transfer (data/device_feed.py) so the train step never sees the
+  dynamic bucketed shapes; host-side ``preprocess`` calls also accept
+  sparse features directly for tests and numpy pipelines.
+  """
+
+  def __init__(self, inner: AbstractPreprocessor, sparse: bool = False):
     super().__init__(inner._model_feature_specification_fn,
                      inner._model_label_specification_fn)
     self._inner = inner
+    self.sparse = bool(sparse)
     keys = self.image_keys('train')
     if not keys:
       raise ValueError(
@@ -93,10 +119,11 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
   def get_in_feature_specification(self, mode: str) -> SpecStruct:
     spec = algebra.flatten_spec_structure(
         self._inner.get_in_feature_specification(mode))
+    make_specs = sparse_coef_specs if self.sparse else coef_specs
     out = SpecStruct()
     for key in spec:
       if coef_eligible(spec[key]):
-        for ckey, cspec in coef_specs(key, spec[key]).items():
+        for ckey, cspec in make_specs(key, spec[key]).items():
           out[ckey] = cspec
       else:
         out[key] = spec[key]
@@ -116,8 +143,17 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
     """Finish the JPEG decode on device, then run the wrapped preprocessor
     (which validates against its own in-specs)."""
     features = SpecStruct(**{k: features[k] for k in features})
-    features = jpeg_device.decode_coef_features(
-        features, self.image_keys(mode))
+    keys = self.image_keys(mode)
+    if any(key + '/sd' in features for key in keys):
+      # Sparse streams straight from the loader (host/test convenience;
+      # the Trainer path unpacks BEFORE the jitted step via
+      # data/device_feed.py to keep the step shape-stable).
+      spec = algebra.flatten_spec_structure(
+          self._inner.get_in_feature_specification(mode))
+      features = jpeg_device.unpack_sparse_features(
+          features,
+          {key: (spec[key].shape[0], spec[key].shape[1]) for key in keys})
+    features = jpeg_device.decode_coef_features(features, keys)
     return self._inner.preprocess(features, labels, mode, rng=rng)
 
   def _preprocess_fn(self, features, labels, mode: str, rng=None):

@@ -114,6 +114,26 @@ class Trainer:
     self._profiling = False
     self._train_writer = None
     self._eval_writer = None
+    self._device_feed = None
+    self._device_feed_built = False
+
+  def _put_batch(self, batch: dict):
+    """Host batch -> sharded device batch, sparse-coef aware.
+
+    With a DeviceDecodePreprocessor(sparse=True) pipeline the input
+    batches carry bucketed sparse DCT streams; the feed unpacks them to
+    the fixed-shape dense coefficient tensors right after transfer so the
+    jitted step never recompiles (data/device_feed.py). Everything else
+    is a plain shard_batch.
+    """
+    if not self._device_feed_built:
+      from tensor2robot_tpu.data.device_feed import SparseCoefFeed
+      self._device_feed = SparseCoefFeed.from_preprocessor(
+          self.model.preprocessor, self.mesh)
+      self._device_feed_built = True
+    if self._device_feed is None:
+      return sharding_lib.shard_batch(batch, self.mesh)
+    return self._device_feed.put_batch(batch)
 
   @property
   def train_metrics_writer(self):
@@ -320,10 +340,9 @@ class Trainer:
     while step_i < max_train_steps:
       self._maybe_profile(step_i)
       features, labels = batch
-      device_batch = sharding_lib.shard_batch(
+      device_batch = self._put_batch(
           {'features': features.to_dict(),
-           'labels': labels.to_dict() if labels is not None else None},
-          self.mesh)
+           'labels': labels.to_dict() if labels is not None else None})
       state, metrics = step_fn(state, device_batch['features'],
                                device_batch['labels'], base_rng)
       step_i += 1
@@ -385,10 +404,9 @@ class Trainer:
           break
       features, labels = batch
       batch = None
-      device_batch = sharding_lib.shard_batch(
+      device_batch = self._put_batch(
           {'features': features.to_dict(),
-           'labels': labels.to_dict() if labels is not None else None},
-          self.mesh)
+           'labels': labels.to_dict() if labels is not None else None})
       metrics = jax.device_get(
           eval_fn(state, device_batch['features'], device_batch['labels']))
       for key, value in metrics.items():
@@ -439,10 +457,10 @@ class Trainer:
       return  # default no-op implementation: skip the extra forward pass
     try:
       raw_features, raw_labels = batch
-      device_batch = sharding_lib.shard_batch(
+      device_batch = self._put_batch(
           {'features': raw_features.to_dict(),
            'labels': raw_labels.to_dict() if raw_labels is not None
-           else None}, self.mesh)
+           else None})
       features, labels, outputs = self._compile_summary_step()(
           state, device_batch['features'], device_batch['labels'])
       host = jax.device_get

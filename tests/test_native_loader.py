@@ -422,3 +422,125 @@ class TestGeneratorIntegration:
                                       use_native=False)
     gen.set_specification(features_spec, labels_spec)
     assert gen._native_iterator(ModeKeys.TRAIN, 1, 0, 1, None) is None
+
+
+def _gray_with_dots():
+  img = np.full((64, 96, 3), 128, np.uint8)
+  img[0:8, 0:8] = 200       # first block row
+  img[56:64, 88:96] = 60    # last block — >255 empty coef slots between
+  return img
+
+
+class TestSparseCoef:
+  """Sparse DCT entry streams: 'coef_sparse' mode round-trips exactly to
+  the dense 'coef' mode tensors through the device unpack
+  (record_loader.cc decode_jpeg_coef_sparse <-> jpeg_device
+  unpack_sparse_coefficients)."""
+
+  def _streams(self, images, h, w, density=0.5, batch_size=None,
+               quality=95):
+    import os
+    import tempfile
+
+    from tensor2robot_tpu.utils.image import jpeg_string
+    from PIL import Image
+
+    batch_size = batch_size or len(images)
+    features = SpecStruct(image=TensorSpec((h, w, 3), np.uint8, name='im',
+                                           data_format='jpeg'))
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, 's.tfrecord')
+    # The default quality=95 shrinks quant steps so bright DCs exceed int8
+    # and exercise the value-continuation entries.
+    tfrecord.write_records(path, [
+        build_example({'im': jpeg_string(Image.fromarray(im), quality)})
+        for im in images])
+    out = []
+    for mode in ('coef', 'coef_sparse'):
+      plan = native_loader.plan_for_specs(features, SpecStruct(),
+                                          image_mode=mode,
+                                          sparse_density=density)
+      stream = native_loader.NativeBatchedStream(
+          plan, [path], batch_size=batch_size, num_epochs=1, validate=False)
+      try:
+        (feats, _), = list(stream)
+      finally:
+        stream.close()
+      out.append(feats)
+    return out
+
+  def _images(self):
+    rng = np.random.RandomState(3)
+    imgs = [
+        # bright uniform: large positive DCs -> continuation entries
+        np.full((64, 96, 3), 250, np.uint8),
+        # mid-gray with two far-apart features: the all-zero blocks
+        # between them make a gap longer than 255 -> skip entries
+        _gray_with_dots(),
+        # noisy: dense-ish coefficients
+        np.clip(rng.randn(64, 96, 3) * 50 + 128, 0, 255).astype(np.uint8),
+        # gradient scene
+        (np.outer(np.linspace(0, 1, 64), np.linspace(0, 1, 96))[..., None]
+         * [255, 180, 90]).astype(np.uint8),
+    ]
+    return imgs
+
+  def test_exact_coefficient_parity(self):
+    from tensor2robot_tpu.data import jpeg_device
+    dense, sparse = self._streams(self._images(), 64, 96)
+    sd, sv = np.asarray(sparse['image/sd']), np.asarray(sparse['image/sv'])
+    y, cb, cr = jpeg_device.unpack_sparse_coefficients(sd, sv, 64, 96)
+    assert np.array_equal(np.asarray(y), np.asarray(dense['image/y']))
+    assert np.array_equal(np.asarray(cb), np.asarray(dense['image/cb']))
+    assert np.array_equal(np.asarray(cr), np.asarray(dense['image/cr']))
+    assert np.array_equal(np.asarray(sparse['image/qt']),
+                          np.asarray(dense['image/qt']))
+    # Both escape entry kinds were actually exercised.
+    n = np.asarray(sparse['image/n'])
+    assert (sd[0][:n[0]] == 0).any()  # delta-0 continuation (bright DCs)
+    assert (sd[1][:n[1]] == 255).any()  # long-gap skip (empty gray blocks)
+
+  def test_bucketed_stream_shape(self):
+    _, sparse = self._streams(self._images(), 64, 96)
+    sd = np.asarray(sparse['image/sd'])
+    n = np.asarray(sparse['image/n'])
+    assert sd.shape[1] % native_loader.SPARSE_BUCKET == 0
+    assert sd.shape[1] >= int(n.max())
+    assert sd.shape[1] - int(n.max()) < native_loader.SPARSE_BUCKET
+    # Owned copies, not ring-buffer views (use-after-free guard).
+    assert sd.base is None
+
+  def test_all_zero_rows_unpack_to_zero(self):
+    from tensor2robot_tpu.data import jpeg_device
+    sd = np.zeros((2, native_loader.SPARSE_BUCKET), np.uint8)
+    sv = np.zeros((2, native_loader.SPARSE_BUCKET), np.int8)
+    y, cb, cr = jpeg_device.unpack_sparse_coefficients(sd, sv, 32, 32)
+    assert not np.asarray(y).any()
+    assert not np.asarray(cb).any() and not np.asarray(cr).any()
+
+  def test_capacity_overflow_is_a_clear_error(self):
+    rng = np.random.RandomState(0)
+    noisy = [np.clip(rng.randn(128, 160, 3) * 60 + 128, 0, 255)
+             .astype(np.uint8)]
+    with pytest.raises(RuntimeError, match='capacity .* exceeded'):
+      self._streams(noisy, 128, 160, density=0.01)
+
+  def test_sparse_bytes_shrink_vs_dense(self):
+    # Camera-like content (the workload the format exists for): gradient +
+    # objects + mild sensor noise at 512x640, >= 5x fewer bytes than the
+    # dense coefficient tensors (VERDICT r3 item 1 acceptance bar).
+    rng = np.random.RandomState(0)
+    x = np.linspace(0, 1, 640)
+    yy = np.linspace(0, 1, 512)
+    img = (np.outer(yy, x)[..., None] * [200, 160, 240]).astype(np.float32)
+    img[100:180, 200:300] = [250, 40, 10]
+    img += rng.randn(512, 640, 1) * 6
+    img = np.clip(img, 0, 255).astype(np.uint8)
+    # quality=75: what numpy_to_image_string (PIL default) writes — the
+    # replay writer / bench record content this path actually serves.
+    dense, sparse = self._streams([img], 512, 640, quality=75)
+    dense_bytes = sum(np.asarray(dense['image/' + k]).nbytes
+                      for k in ('y', 'cb', 'cr'))
+    sparse_bytes = (np.asarray(sparse['image/sd']).nbytes +
+                    np.asarray(sparse['image/sv']).nbytes)
+    assert dense_bytes / sparse_bytes >= 5.0

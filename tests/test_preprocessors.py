@@ -331,6 +331,71 @@ class TestDeviceDecodePreprocessor:
     finally:
       trainer.close()
 
+  def test_sparse_specs_and_pixel_parity(self, tmp_path):
+    """sparse=True ships delta/value streams; preprocess() unpacks them to
+    the same pixels as the dense coef path (host convenience route)."""
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    model = self._image_model()
+    path = str(tmp_path / 'imgs.tfrecord')
+    frames = self._write_records(path)
+    model.set_preprocessor(
+        DeviceDecodePreprocessor(model.preprocessor, sparse=True))
+    in_spec = model.preprocessor.get_in_feature_specification(ModeKeys.TRAIN)
+    assert 'image/sd' in dict(in_spec) and 'image/qt' in dict(in_spec)
+
+    generator = DefaultRecordInputGenerator(file_patterns=path,
+                                            batch_size=4)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(generator.create_dataset_iterator(
+        mode=ModeKeys.EVAL, num_epochs=1))
+    assert 'image/sd' in features and 'image/y' not in features
+    decoded, _ = model.preprocessor.preprocess(features, labels,
+                                               ModeKeys.EVAL)
+    img = np.asarray(decoded['image'])
+    assert img.shape == (4, 64, 64, 3) and img.dtype == np.uint8
+    from tensor2robot_tpu.utils.image import (
+        image_string_to_numpy,
+        numpy_to_image_string,
+    )
+    host = image_string_to_numpy(numpy_to_image_string(frames[0]))
+    diff = img[0].astype(int) - host.astype(int)
+    assert np.abs(diff).max() <= 4
+
+  def test_trains_from_sparse_records(self, tmp_path):
+    """Full Trainer loop over sparse streams: the SparseCoefFeed unpacks
+    between transfer and the (shape-stable) jitted step."""
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    from tensor2robot_tpu.trainer import Trainer
+    model = self._image_model()
+    path = str(tmp_path / 'imgs.tfrecord')
+    self._write_records(path)
+    model.set_preprocessor(
+        DeviceDecodePreprocessor(model.preprocessor, sparse=True))
+    generator = DefaultRecordInputGenerator(file_patterns=path,
+                                            batch_size=4)
+    trainer = Trainer(model, str(tmp_path / 'run'),
+                      mesh=parallel.create_mesh(
+                          {'data': 1}, devices=jax.devices()[:1]),
+                      async_checkpoints=False,
+                      save_checkpoints_steps=10**9)
+    try:
+      state = trainer.train(generator, max_train_steps=2,
+                            shard_index=0, num_shards=1)
+      assert int(jax.device_get(state.step)) == 2
+    finally:
+      trainer.close()
+
   def test_requires_eligible_image_spec(self):
     from tensor2robot_tpu.preprocessors.device_decode import (
         DeviceDecodePreprocessor,
