@@ -592,6 +592,12 @@ struct Slot {
   std::atomic<int> remaining{0};
   SlotState state = kFree;
   long long seq = -1;  // batch sequence number, for ordered hand-off
+  // First row error in this batch, if any (guarded by Loader::mu). The
+  // fail/discard decision is deferred to batch COMPLETION so that an
+  // error in the EOF-discarded partial batch (drop_remainder semantics)
+  // is swallowed deterministically — at completion time the reader has
+  // either marked the slot seq = -2 or never will.
+  std::string row_error;
 };
 
 struct WorkItem {
@@ -666,6 +672,7 @@ struct Loader {
           slots[i].state = kFilling;
           slots[i].remaining.store(cfg.batch_size);
           slots[i].seq = (*seq)++;
+          slots[i].row_error.clear();
           *cur_slot = (int)i;
           *cur_row = 0;
           break;
@@ -1034,28 +1041,32 @@ struct Loader {
       }
       cv_space.notify_one();
       std::string err = parse_into(item.record, item.slot, item.row);
-      if (!err.empty()) {
-        // A decode/parse error on a row of the EOF-discarded partial batch
-        // (seq == -2, set by the reader under mu) is an error on data that
-        // drop_remainder semantics throw away anyway: complete the row
-        // normally so the slot recycles instead of erroring the stream.
-        bool discarded;
-        {
-          std::lock_guard<std::mutex> lk(mu);
-          discarded = slots[item.slot].seq == -2;
-        }
-        if (!discarded) {
-          fail(err);
-          return;
-        }
-      }
       Slot& slot = slots[item.slot];
+      if (!err.empty()) {
+        // Record the error but DEFER the fail/swallow decision to batch
+        // completion: whether this batch is the EOF-discarded partial
+        // batch (drop_remainder semantics — error irrelevant) is only
+        // known for sure once all its rows are in, making the swallow
+        // deterministic rather than a race against the reader reaching
+        // EOF and marking seq = -2.
+        std::lock_guard<std::mutex> lk(mu);
+        if (slot.row_error.empty()) slot.row_error = err;
+      }
       if (slot.remaining.fetch_sub(1) == 1) {
         std::lock_guard<std::mutex> lk(mu);
         if (slot.seq == -2) {  // discarded partial batch at EOF
           slot.state = kFree;
           cv_free.notify_one();
           cv_ready.notify_all();  // consumer may be waiting on the EOF check
+        } else if (!slot.row_error.empty()) {
+          // fail() under mu would deadlock; set the error state inline.
+          if (error.empty()) error = slot.row_error;
+          stop = true;
+          cv_ready.notify_all();
+          cv_work.notify_all();
+          cv_free.notify_all();
+          cv_space.notify_all();
+          return;
         } else {
           slot.state = kReady;
           // Insert in seq order so batches come out deterministically.

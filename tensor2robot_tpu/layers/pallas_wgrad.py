@@ -1,0 +1,124 @@
+"""Pallas 5x5 conv weight-gradient kernel — the measured record.
+
+THE EXPERIMENT (round 4, closing VERDICT r3 item 2): QT-Opt's headline is
+bounded by its six 5x5/64-channel conv weight-gradients
+(/root/reference/research/qtopt/networks.py:449-520 defines the stack; the
+per-fusion profile in docs/performance.md attributes 42.3 ms of the 175 ms
+batch-512 step to them, running at ~96 TF/s inside XLA's fused step). The
+open question from round 3 was whether a hand Mosaic kernel in im2col/
+matmul form could beat XLA's conv emitter. It cannot — measured on one
+v5e, isolated op, x/dy [512, 79, 79, 64] bf16, dW [5, 5, 64, 64] f32
+(654 GFLOP), same chained-timing harness for every row:
+
+  XLA wgrad (jax.vjp of conv_general_dilated)   10.3 ms   63.8 TF/s
+  v1 (this file): 25 shifted-slice dots/chunk   23.7 ms   27.6 TF/s
+  v2: in-kernel 128-packed operands             30.4 ms   21.5 TF/s
+  v3: HBM-prebuilt 128-packed, zero in-kernel
+      sublane slicing, pure 128x128 passes      31.7 ms   20.7 TF/s
+
+v2/v3 tested the "quarter-MXU" theory — that 64x64 output tiles waste the
+128x128 systolic array and packing 4 kernel offsets per pass via the
+shifted-operand identity (sum_p X[p+a]dY[p+b] = dW[a-b] under zero
+padding) would ~4x the pass rate. The packed passes were NOT faster:
+Mosaic's lowering of row-contracted dots ([R,64]^T @ [R,64], contraction
+on the sublane axis) pays an operand relayout that dominates regardless
+of output width, and the extra operand bytes (doubled channels) make v2/v3
+strictly worse. With the strongest formulation 2.3x behind XLA's isolated
+emitter — which itself runs 50% faster again inside the fused step — the
+conv/wgrad emitter wall stands. The "why 4,000 ex/s is out of reach"
+case in docs/performance.md now rests on measurement, not extrapolation.
+
+Mosaic/v5e restrictions hit on the way (each cost a compile cycle):
+  * odd sublane extents (W=79 -> 83-wide blocks) crash the bf16 packer
+    outright — pad spatial dims to multiples of 8;
+  * dynamic-start slices on the sublane axis need provably 8-aligned
+    offsets ("cannot statically prove index is a multiple of 8");
+  * lane-dim concat of two slices with different sublane offsets fails
+    ("result/input offset mismatch on non-concat dimension") — reshape
+    each slice to 2D first to normalize layouts;
+  * a python-unrolled 25-slice loop keeps every shifted copy live and
+    blows the 16 MB scoped-VMEM cap at real shapes — chunk the H axis
+    through a fori_loop and keep temporaries ~100 KB.
+
+The kernel is kept (a) as the parity-tested record backing the numbers
+above, (b) because its structure (outer-dim windowing + chunked
+accumulation) is the template any future conv-kernel attempt would start
+from. Use XLA's conv for production training; nothing imports this on the
+hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+KH = KW = 5
+_PAD = 2  # SAME padding for 5x5
+_CHUNK = 8  # H rows per accumulation chunk
+
+
+def _wgrad_kernel(x_ref, dy_ref, out_ref):
+  """Accumulates dW[25*C, C] f32 over batch-tile grid steps.
+
+  x_ref: [bt, Hp+4, Wp+4, C] bf16, zero-padded (SAME + alignment).
+  dy_ref: [bt, Hp, Wp, C] bf16, zero-padded (alignment pads kill the
+    extra products exactly).
+  """
+  i = pl.program_id(0)
+  bt, _, _, c = x_ref.shape
+  _, h, w, _ = dy_ref.shape
+  cs = _CHUNK
+
+  @pl.when(i == 0)
+  def _():
+    out_ref[...] = jnp.zeros_like(out_ref)
+
+  def body(ch, carry):
+    dy = dy_ref[:, pl.dslice(ch * cs, cs), :, :].reshape(bt * cs * w, c)
+    for dh in range(KH):
+      xrow = x_ref[:, pl.dslice(ch * cs + dh, cs), :, :]
+      for dw in range(KW):
+        xs = xrow[:, :, dw:dw + w, :].reshape(bt * cs * w, c)
+        acc = jax.lax.dot_general(
+            xs, dy, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[dh * (KW * c) + dw * c:dh * (KW * c) + (dw + 1) * c,
+                :] += acc
+    return carry
+
+  jax.lax.fori_loop(0, h // cs, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=('batch_tile', 'interpret'))
+def conv5x5_wgrad(x: jnp.ndarray, dy: jnp.ndarray, batch_tile: int = 2,
+                  interpret: bool = False) -> jnp.ndarray:
+  """dW of a 5x5 stride-1 SAME conv: x [B,H,W,C], dy [B,H,W,C] -> [5,5,C,C].
+
+  Matches jax.vjp of lax.conv_general_dilated('NHWC','HWIO','NHWC') with
+  f32 accumulation (parity test: tests/test_layers.py).
+  """
+  b, h, w, c = x.shape
+  if b % batch_tile:
+    raise ValueError('batch %d not divisible by batch_tile %d'
+                     % (b, batch_tile))
+  hp = -(-h // _CHUNK) * _CHUNK
+  wp = -(-w // 8) * 8
+  xp = jnp.pad(x, ((0, 0), (_PAD, _PAD + hp - h), (_PAD, _PAD + wp - w),
+                   (0, 0)))
+  dyp = jnp.pad(dy, ((0, 0), (0, hp - h), (0, wp - w), (0, 0)))
+  out = pl.pallas_call(
+      _wgrad_kernel,
+      grid=(b // batch_tile,),
+      in_specs=[
+          pl.BlockSpec((batch_tile, hp + 4, wp + 4, c),
+                       lambda i: (i, 0, 0, 0)),
+          pl.BlockSpec((batch_tile, hp, wp, c), lambda i: (i, 0, 0, 0)),
+      ],
+      out_specs=pl.BlockSpec((KH * KW * c, c), lambda i: (0, 0)),
+      out_shape=jax.ShapeDtypeStruct((KH * KW * c, c), jnp.float32),
+      interpret=interpret,
+  )(xp, dyp)
+  return out.reshape(KH, KW, c, c)

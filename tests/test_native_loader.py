@@ -544,3 +544,50 @@ class TestSparseCoef:
     sparse_bytes = (np.asarray(sparse['image/sd']).nbytes +
                     np.asarray(sparse['image/sv']).nbytes)
     assert dense_bytes / sparse_bytes >= 5.0
+
+
+class TestDroppedRemainderErrors:
+
+  def test_corrupt_record_in_dropped_partial_batch_is_swallowed(
+      self, tmp_path):
+    """drop_remainder semantics: a decode error on a record that falls in
+    the discarded EOF partial batch must not error the stream. The
+    fail/swallow decision is deferred to batch completion in the C++
+    worker, so this holds deterministically (not just when the reader
+    wins the race to EOF)."""
+    features = SpecStruct(image=TensorSpec((16, 16, 3), np.uint8,
+                                           name='im', data_format='jpeg'))
+    rng = np.random.RandomState(0)
+    records = [build_example({'im': numpy_to_image_string(
+        rng.randint(0, 255, (16, 16, 3), dtype=np.uint8))})
+        for _ in range(4)]
+    # Record 5 of 5 is garbage; batch_size=4 drops it as the remainder.
+    records.append(build_example({'im': b'not a jpeg'}))
+    path = str(tmp_path / 'tail.tfrecord')
+    tfrecord.write_records(path, records)
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    for _ in range(10):  # the old behavior was a thread-timing race
+      stream = native_loader.NativeBatchedStream(
+          plan, [path], batch_size=4, num_epochs=1)
+      try:
+        batches = list(stream)
+      finally:
+        stream.close()
+      assert len(batches) == 1
+      assert np.asarray(batches[0][0]['image']).shape == (4, 16, 16, 3)
+
+  def test_corrupt_record_in_delivered_batch_still_fails(self, tmp_path):
+    features = SpecStruct(image=TensorSpec((16, 16, 3), np.uint8,
+                                           name='im', data_format='jpeg'))
+    records = [build_example({'im': b'not a jpeg'})
+               for _ in range(4)]
+    path = str(tmp_path / 'bad.tfrecord')
+    tfrecord.write_records(path, records)
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=4, num_epochs=1)
+    with pytest.raises(RuntimeError, match='jpeg'):
+      try:
+        list(stream)
+      finally:
+        stream.close()
