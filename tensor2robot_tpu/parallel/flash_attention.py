@@ -22,12 +22,15 @@ dimension:
   at the last k block: out = acc / l
 
 Memory: per-device O(L*D) activations only — no score tensor ever reaches
-HBM. Numerics match the XLA oracle to f32 rounding
-(tests/test_flash_attention.py); measured speed/memory comparison in
-docs/performance.md (~4-5x over XLA attention at 16k tokens; runs 32k
-where XLA OOMs). This is the single-device long-context path;
-ring_attention.py handles the cross-device dimension with its own
-shard-level blockwise accumulation.
+HBM, forward OR backward: since round 4 the backward is the same kernel
+family (two Pallas kernels, FlashAttention-2 structure, causal block
+skip — _flash_bwd_pallas) instead of an XLA scan. Numerics match the XLA
+oracle to f32 rounding (tests/test_flash_attention.py); measured numbers
+in docs/performance.md (B=1 H=8 D=128 causal, jax 0.9: fwd 7.7/12.1/29.6
+ms at L=4k/16k/32k vs XLA 13.1/46.4/OOM; fwd+bwd 10.8/18.1/60.4 ms vs
+XLA 11.3/uncompilable/uncompilable). This is the single-device
+long-context path; ring_attention.py handles the cross-device dimension
+with its own shard-level blockwise accumulation.
 """
 
 from __future__ import annotations
@@ -44,6 +47,26 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _dividing_block_or_raise(requested: int, l: int) -> int:
+  """Largest block <= requested that divides L (power-of-two ladder).
+
+  Raises for lengths nothing on the ladder divides (L % 8 != 0) instead
+  of silently returning L itself — a full-length "block" bypasses the
+  VMEM sizing the caps encode and surfaces later as an opaque Mosaic
+  scoped-vmem error. Callers pad the sequence instead.
+  """
+  for candidate in (requested, 512, 256, 128, 64, 32, 16, 8):
+    if (candidate % 8 == 0 and candidate <= l and l % candidate == 0
+        and candidate <= requested):
+      # candidate % 8: requested itself heads the ladder, and for L <=
+      # requested that first candidate is L — an 8-misaligned L must fall
+      # through to the raise, not return itself as a full-length "block".
+      return candidate
+  raise ValueError(
+      'No flash-attention block size <= {} divides sequence length {}; '
+      'pad the sequence to a multiple of 8.'.format(requested, l))
+
+
 def _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   q_offset, k_offset, i_q, i_k):
@@ -55,6 +78,12 @@ def _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
   scalars) for causal masking. ``i_q``/``i_k`` are the grid indices,
   passed in because pl.program_id cannot be called inside a pl.when
   branch under the CPU interpreter.
+
+  m/l scratch is [bq, 128] with the per-row scalar broadcast UNIFORMLY
+  across all 128 lanes: jax 0.9's Mosaic rejects sub-slicing width-1
+  VMEM memrefs ("slice shape along dimension 1 must be aligned to
+  tiling (128)"), so the scalars are read back with a lane-reduce and
+  stored with a broadcast instead of living in [bq, 1] refs.
   """
   q = q_ref[0].astype(jnp.float32)                       # [bq, D]
   k = k_ref[0].astype(jnp.float32)                       # [bk, D]
@@ -68,8 +97,8 @@ def _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
         jnp.int32, (block_q, block_k), 1))
     s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
-  m_prev = m_ref[:]                                      # [bq, 1]
-  l_prev = l_ref[:]
+  m_prev = jnp.max(m_ref[...], axis=-1, keepdims=True)   # [bq, 1]
+  l_prev = jnp.max(l_ref[...], axis=-1, keepdims=True)
   m_block = jnp.max(s, axis=-1, keepdims=True)           # [bq, 1]
   m_new = jnp.maximum(m_prev, m_block)
   safe_m = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
@@ -77,8 +106,9 @@ def _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
   p = jnp.where(s <= NEG_INF / 2, 0.0, p)
   correction = jnp.exp(m_prev - safe_m)
   correction = jnp.where(m_prev <= NEG_INF / 2, 0.0, correction)
-  l_ref[:] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-  m_ref[:] = m_new
+  l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+  l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+  m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
   acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
       p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -109,8 +139,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
   @pl.when(i_k == 0)
   def _init():
     acc_ref[rows, :] = jnp.zeros((block_q, acc_ref.shape[-1]), jnp.float32)
-    m_ref[rows, :] = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l_ref[rows, :] = jnp.zeros((block_q, 1), jnp.float32)
+    m_ref[rows, :] = jnp.full((block_q, 128), NEG_INF, jnp.float32)
+    l_ref[rows, :] = jnp.zeros((block_q, 128), jnp.float32)
 
   def _do_update():
     # One shared numerics implementation (_block_update) for both this
@@ -131,11 +161,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
   @pl.when(i_k == n_k - 1)
   def _finalize():
-    l_final = jnp.maximum(l_ref[rows, :], 1e-20)
+    l_col = jnp.max(l_ref[rows, :], axis=-1, keepdims=True)    # [bq, 1]
+    m_col = jnp.max(m_ref[rows, :], axis=-1, keepdims=True)
+    l_final = jnp.maximum(l_col, 1e-20)
     o_ref[0] = (acc_ref[rows, :] / l_final).astype(o_ref.dtype)
     # Log-sum-exp per row, saved for the backward pass (FlashAttention).
     # Broadcast over the 8 padding sublanes (see _flash_bhld's lse shape).
-    row = (m_ref[rows, :] + jnp.log(l_final))[:, 0]
+    row = (m_col + jnp.log(l_final))[:, 0]
     lse_ref[0] = jnp.broadcast_to(row[None, :], (8, block_q))
 
 
@@ -188,8 +220,9 @@ def _flash_bhld(q, k, v, *, scale: float, causal: bool, block_q: int,
       ],
       scratch_shapes=[
           pltpu.VMEM((tile_rows, d), jnp.float32),
-          pltpu.VMEM((tile_rows, 1), jnp.float32),
-          pltpu.VMEM((tile_rows, 1), jnp.float32),
+          # 128 uniform lanes per scalar — see _block_update's m/l note.
+          pltpu.VMEM((tile_rows, 128), jnp.float32),
+          pltpu.VMEM((tile_rows, 128), jnp.float32),
       ],
       interpret=interpret,
   )(q, k, v)
@@ -216,9 +249,14 @@ def _flash_carry_kernel(offsets_ref, q_ref, k_ref, v_ref, o_in_ref,
   def _init():
     acc_ref[:] = o_in_ref[0].astype(jnp.float32)
     # m/l ride in [1, 8, block_q] blocks (8 broadcast sublanes — Mosaic's
-    # output-block divisibility rule; see _flash_bhld's lse note).
-    m_ref[:] = m_in_ref[0, 0].astype(jnp.float32)[:, None]
-    l_ref[:] = l_in_ref[0, 0].astype(jnp.float32)[:, None]
+    # output-block divisibility rule; see _flash_bhld's lse note). Reduce
+    # over the uniform sublanes rather than slicing one (width-1 memref
+    # slices are rejected by jax 0.9 Mosaic), then broadcast across the
+    # 128 scalar lanes of the scratch.
+    m_col = jnp.max(m_in_ref[0].astype(jnp.float32), axis=0)[:, None]
+    l_col = jnp.max(l_in_ref[0].astype(jnp.float32), axis=0)[:, None]
+    m_ref[...] = jnp.broadcast_to(m_col, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_col, l_ref.shape)
 
   def _do_update():
     _block_update(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, scale=scale,
@@ -240,10 +278,10 @@ def _flash_carry_kernel(offsets_ref, q_ref, k_ref, v_ref, o_in_ref,
   @pl.when(i_k == n_k - 1)
   def _finalize():
     o_out_ref[0] = acc_ref[:]
-    m_out_ref[0] = jnp.broadcast_to(m_ref[:][:, 0][None, :],
-                                    (8, block_q))
-    l_out_ref[0] = jnp.broadcast_to(l_ref[:][:, 0][None, :],
-                                    (8, block_q))
+    m_row = jnp.max(m_ref[...], axis=-1)                     # [bq]
+    l_row = jnp.max(l_ref[...], axis=-1)
+    m_out_ref[0] = jnp.broadcast_to(m_row[None, :], (8, block_q))
+    l_out_ref[0] = jnp.broadcast_to(l_row[None, :], (8, block_q))
 
 
 def flash_attention_carry(q, k, v, o, m, l, q_offset, k_offset,
@@ -297,8 +335,8 @@ def flash_attention_carry(q, k, v, o, m, l, q_offset, k_offset,
       ],
       scratch_shapes=[
           pltpu.VMEM((block_q, d), jnp.float32),
-          pltpu.VMEM((block_q, 1), jnp.float32),
-          pltpu.VMEM((block_q, 1), jnp.float32),
+          pltpu.VMEM((block_q, 128), jnp.float32),
+          pltpu.VMEM((block_q, 128), jnp.float32),
       ],
   )
   o_out, m_out8, l_out8 = pl.pallas_call(
@@ -314,64 +352,231 @@ def flash_attention_carry(q, k, v, o, m, l, q_offset, k_offset,
   return o_out, m_out8[:, 0, :], l_out8[:, 0, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret):
+# Backward block sizes are DECOUPLED from the forward defaults: the
+# forward's (1024, 1024) tuning holds one [bq, bk] f32 score block; the
+# backward holds four ([s, p, dp, ds]) plus two accumulator blocks, so the
+# same sizes would 4x the peak VMEM and OOM at the L=32k headline case.
+BWD_BLOCK_Q = 512
+BWD_BLOCK_K = 512
+
+
+def _bwd_p_ds(q, k, v, do, lse, delta, *, scale, causal, q_base, k_base,
+              block_q, block_k):
+  """Shared recompute for both backward kernels: (p, ds) for one block
+  pair, from the saved log-sum-exp. All operands f32 2D blocks."""
+  s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32) * scale
+  if causal:
+    q_pos = q_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_base + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+  p = jnp.exp(s - lse)
+  if causal:
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+  dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+  ds = p * (dp - delta) * scale
+  return p, ds
+
+
+def _flash_bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                         causal: bool, block_q: int, block_k: int):
+  """dk/dv: grid (bh, n_k, n_q) — k/v block resident (accumulators in
+  scratch), q/do/lse/delta stream through."""
+  i_k = pl.program_id(1)
+  i_q = pl.program_id(2)
+  n_q = pl.num_programs(2)
+
+  @pl.when(i_q == 0)
+  def _init():
+    dk_acc[...] = jnp.zeros_like(dk_acc)
+    dv_acc[...] = jnp.zeros_like(dv_acc)
+
+  def _update():
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    # Reduce over the uniform broadcast sublanes instead of slicing one
+    # (width-1 memref slices are rejected by jax 0.9 Mosaic).
+    lse = jnp.max(lse_ref[0].astype(jnp.float32), axis=0)[:, None]
+    delta = jnp.max(delta_ref[0].astype(jnp.float32), axis=0)[:, None]
+    p, ds = _bwd_p_ds(q, k_ref[0].astype(jnp.float32),
+                      v_ref[0].astype(jnp.float32), do, lse, delta,
+                      scale=scale, causal=causal,
+                      q_base=i_q * block_q, k_base=i_k * block_k,
+                      block_q=block_q, block_k=block_k)
+    dv_acc[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_acc[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+  if causal:
+    # Blocks fully above the diagonal contribute nothing to dk/dv.
+    @pl.when(i_q * block_q + block_q - 1 >= i_k * block_k)
+    def _():
+      _update()
+  else:
+    _update()
+
+  @pl.when(i_q == n_q - 1)
+  def _finalize():
+    dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_acc, *, scale: float, causal: bool,
+                        block_q: int, block_k: int):
+  """dq: grid (bh, n_q, n_k) — q block resident, k/v stream through."""
+  i_q = pl.program_id(1)
+  i_k = pl.program_id(2)
+  n_k = pl.num_programs(2)
+
+  @pl.when(i_k == 0)
+  def _init():
+    dq_acc[...] = jnp.zeros_like(dq_acc)
+
+  def _update():
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = jnp.max(lse_ref[0].astype(jnp.float32), axis=0)[:, None]
+    delta = jnp.max(delta_ref[0].astype(jnp.float32), axis=0)[:, None]
+    k = k_ref[0].astype(jnp.float32)
+    _, ds = _bwd_p_ds(q, k, v_ref[0].astype(jnp.float32), do, lse, delta,
+                      scale=scale, causal=causal,
+                      q_base=i_q * block_q, k_base=i_k * block_k,
+                      block_q=block_q, block_k=block_k)
+    dq_acc[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+  if causal:
+    @pl.when(i_q * block_q + block_q - 1 >= i_k * block_k)
+    def _():
+      _update()
+  else:
+    _update()
+
+  @pl.when(i_k == n_k - 1)
+  def _finalize():
+    dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, d_out, *, scale, causal,
+                      block_q, block_k, interpret):
+  """Full Pallas backward: dq, dk, dv over [BH, L, D] operands.
+
+  Two kernels (FlashAttention-2 structure): dk/dv with the k/v block
+  resident and q streaming, dq with the q block resident and k/v
+  streaming. P is recomputed from the forward's saved log-sum-exp; no
+  [L, L] tensor exists in either pass. delta = rowsum(do * out) is one
+  fused elementwise pass XLA handles before the kernels.
+  """
+  bh, l_q, d = q.shape
+  l_k = k.shape[1]
+  n_q = l_q // block_q
+  n_k = l_k // block_k
+  do = d_out.astype(jnp.float32)
+  delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)      # [BH, Lq]
+  # lse/delta ride as [BH, 8, L] broadcast-sublane blocks (Mosaic's
+  # second-minor divisibility rule — same scheme as the forward's lse).
+  lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, l_q))
+  delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, l_q))
+
+  kv_kernel = functools.partial(
+      _flash_bwd_kv_kernel, scale=scale, causal=causal, block_q=block_q,
+      block_k=block_k)
+  dk, dv = pl.pallas_call(
+      kv_kernel,
+      grid=(bh, n_k, n_q),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+          pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+          pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+          pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct(k.shape, k.dtype),
+          jax.ShapeDtypeStruct(v.shape, v.dtype),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_k, d), jnp.float32),
+          pltpu.VMEM((block_k, d), jnp.float32),
+      ],
+      interpret=interpret,
+  )(q, k, v, d_out, lse8, delta8)
+
+  q_kernel = functools.partial(
+      _flash_bwd_q_kernel, scale=scale, causal=causal, block_q=block_q,
+      block_k=block_k)
+  dq = pl.pallas_call(
+      q_kernel,
+      grid=(bh, n_q, n_k),
+      in_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+          pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+          pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+          pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+          pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+      ],
+      out_specs=[
+          pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+      ],
+      out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+      scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+      interpret=interpret,
+  )(q, k, v, d_out, lse8, delta8)[0]
+  return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_diff(q, k, v, causal, scale, block_q, block_k, interpret,
+                block_q_bwd, block_k_bwd):
   """custom_vjp core over [BH, L, D] operands."""
+  del block_q_bwd, block_k_bwd  # backward-only
   out, _ = _flash_bhld(q, k, v, scale=scale, causal=causal,
                        block_q=block_q, block_k=block_k,
                        interpret=interpret)
   return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               block_q_bwd, block_k_bwd):
+  del block_q_bwd, block_k_bwd
   out, lse = _flash_bhld(q, k, v, scale=scale, causal=causal,
                          block_q=block_q, block_k=block_k,
                          interpret=interpret)
   return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, d_out):
-  """Blockwise FlashAttention backward: a scan over k/v blocks.
+def _flash_bwd(causal, scale, block_q, block_k, interpret, block_q_bwd,
+               block_k_bwd, residuals, d_out):
+  """Pallas FlashAttention-2 backward (see _flash_bwd_pallas).
 
-  Recomputes P per block from the saved log-sum-exp; memory stays
-  O(L * block_k) — the [L, L] score tensor is never materialized. XLA
-  compiles the scan body (it is matmul-dominated, so the MXU sees the
-  same shapes as the forward kernel).
-  """
-  del block_q
+  Until round 4 this was an XLA lax.scan recompute; it is now the same
+  kernel family as the forward, with causal block skip and its own block
+  sizes (BWD_BLOCK_Q/K defaults — the forward's 1024 would 4x the
+  backward's VMEM working set and OOM the L=32k case)."""
   q, k, v, out, lse = residuals
-  bh, l_q, d = q.shape
+  l_q = q.shape[1]
   l_k = k.shape[1]
-  n_k = l_k // block_k
-  qf = q.astype(jnp.float32)
-  do = d_out.astype(jnp.float32)
-  delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)       # [BH, Lq]
-  k_blocks = k.astype(jnp.float32).reshape(bh, n_k, block_k, d)
-  v_blocks = v.astype(jnp.float32).reshape(bh, n_k, block_k, d)
-  q_pos = jnp.arange(l_q)
-
-  def body(dq_acc, inputs):
-    j, k_j, v_j = inputs                                       # [BH, bk, D]
-    s = jnp.einsum('bqd,bkd->bqk', qf, k_j) * scale            # [BH, Lq, bk]
-    if causal:
-      k_pos = j * block_k + jnp.arange(block_k)
-      s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :], s,
-                    NEG_INF)
-    p = jnp.exp(s - lse[:, :, None])
-    dv_j = jnp.einsum('bqk,bqd->bkd', p, do)
-    dp = jnp.einsum('bqd,bkd->bqk', do, v_j)
-    ds = p * (dp - delta[:, :, None]) * scale
-    dk_j = jnp.einsum('bqk,bqd->bkd', ds, qf)
-    dq_acc = dq_acc + jnp.einsum('bqk,bkd->bqd', ds, k_j)
-    return dq_acc, (dk_j, dv_j)
-
-  dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-      body, jnp.zeros(q.shape, jnp.float32),
-      (jnp.arange(n_k), k_blocks.transpose(1, 0, 2, 3),
-       v_blocks.transpose(1, 0, 2, 3)))
-  dk = dk_blocks.transpose(1, 0, 2, 3).reshape(k.shape)
-  dv = dv_blocks.transpose(1, 0, 2, 3).reshape(v.shape)
-  return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+  bq = _dividing_block_or_raise(min(block_q_bwd or BWD_BLOCK_Q, l_q), l_q)
+  bk = _dividing_block_or_raise(min(block_k_bwd or BWD_BLOCK_K, l_k), l_k)
+  dq, dk, dv = _flash_bwd_pallas(
+      q, k, v, out, lse, d_out, scale=scale, causal=causal,
+      block_q=bq, block_k=bk, interpret=interpret)
+  return dq, dk, dv
 
 
 _flash_diff.defvjp(_flash_fwd, _flash_bwd)
@@ -382,7 +587,9 @@ def flash_attention(q, k, v,
                     scale: Optional[float] = None,
                     block_q: int = 1024,
                     block_k: int = 1024,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None):
   """Exact attention over [B, L, H, D] inputs, O(L) memory, differentiable.
 
   Forward runs the Pallas kernel (k-outer/q-inner tiled sweep, see
@@ -393,10 +600,17 @@ def flash_attention(q, k, v,
   tests run on CPU.
 
   Default block sizes come from v5e sweeps (B=1, H=8, D=128, causal,
-  chained on-device timing): (1024, 1024) measures 5.0/6.2/~9/25.5 ms at
-  L=4k/8k/16k/32k — grid-step count (fixed per-step overhead) and k/v
-  re-fetch traffic are the levers, so bigger blocks win until the
-  f32 score matrix presses the 16 MB scoped-VMEM limit.
+  chained on-device timing): (1024, 1024) — grid-step count (fixed
+  per-step overhead) and k/v re-fetch traffic are the levers, so bigger
+  blocks win until the f32 score matrix presses the 16 MB scoped-VMEM
+  limit. Measured ms in docs/performance.md.
+
+  Head dims below 128 are zero-padded up to 128 for the kernels: jax
+  0.9's Mosaic rejects memref slices whose lane extent is not 128-aligned,
+  which the accumulator sub-refs need. Exact — zero k/v columns change
+  neither scores nor outputs; padding/slicing happens outside the
+  custom_vjp so the backward sees the padded problem and autodiff of the
+  pad/slice restores [.., d] gradients.
   """
   if scale is None:
     scale = 1.0 / float(np.sqrt(q.shape[-1]))
@@ -412,25 +626,18 @@ def flash_attention(q, k, v,
     block_q = min(block_q, 256)
     block_k = min(block_k, 512)
 
-  def _dividing_block(requested, l):
-    """Largest block <= requested that divides L (stepping down through
-    the power-of-two ladder), so any L works at reduced block efficiency
-    instead of raising."""
-    for candidate in (requested, 512, 256, 128, 64, 32, 16, 8):
-      if candidate <= l and l % candidate == 0 and candidate <= requested:
-        return candidate
-    return l
+  block_q = _dividing_block_or_raise(min(block_q, l_q), l_q)
+  block_k = _dividing_block_or_raise(min(block_k, l_k), l_k)
 
-  block_q = _dividing_block(min(block_q, l_q), l_q)
-  block_k = _dividing_block(min(block_k, l_k), l_k)
-  if l_q % block_q or l_k % block_k:  # unreachable: l divides l
-    raise ValueError(
-        'Sequence lengths ({}, {}) must be multiples of the block sizes '
-        '({}, {}).'.format(l_q, l_k, block_q, block_k))
+  dp = -(-d // 128) * 128 if not interpret else d
 
   def _to_bhld(x):
-    return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    x = x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    if dp != d:
+      x = jnp.pad(x, ((0, 0), (0, 0), (0, dp - d)))
+    return x
 
   out = _flash_diff(_to_bhld(q), _to_bhld(k), _to_bhld(v), causal, scale,
-                    block_q, block_k, interpret)
+                    block_q, block_k, interpret, block_q_bwd, block_k_bwd)
+  out = out[:, :, :d] if dp != d else out
   return out.reshape(b, h, l_q, d).transpose(0, 2, 1, 3)
