@@ -50,15 +50,6 @@ def _encodeable(feature_value):
   return np.asarray(values, np.int64)
 
 
-def _is_sequence_example(serialized: bytes) -> bool:
-  """True if the record parses with a non-empty feature_lists side."""
-  try:
-    _, feature_lists = wire.parse_sequence_example(serialized)
-    return bool(feature_lists)
-  except Exception:  # noqa: BLE001 - malformed -> treat as plain Example
-    return False
-
-
 def make_meta_example(condition_examples: Sequence[bytes],
                       inference_examples: Sequence[bytes]) -> bytes:
   """Merges serialized episode Examples into one meta-example record.
@@ -69,22 +60,29 @@ def make_meta_example(condition_examples: Sequence[bytes],
   """
   if not condition_examples or not inference_examples:
     raise ValueError('Need at least one condition and one inference example.')
-  sequence = _is_sequence_example(condition_examples[0])
-  merged_context: Dict[str, object] = {}
-  merged_lists: Dict[str, list] = {}
+  # Parse every record ONCE as a SequenceExample: a plain Example is
+  # wire-identical to a SequenceExample with empty feature_lists (features
+  # and context share field 1), so this reads both. The bundle merges in
+  # sequence mode if ANY record has a feature_lists side — the earlier
+  # first-record-only detection silently dropped the feature_lists of
+  # sequence records bundled behind a plain first record.
+  parsed = []
   for prefix, examples in ((CONDITION_PREFIX, condition_examples),
                            (INFERENCE_PREFIX, inference_examples)):
     for i, record in enumerate(examples):
-      tag = '{}{}/'.format(prefix, i)
-      if sequence:
+      try:
         context, feature_lists = wire.parse_sequence_example(record)
-        for name, value in context.items():
-          merged_context[tag + name] = _encodeable(value)
-        for name, steps in feature_lists.items():
-          merged_lists[tag + name] = [_encodeable(s) for s in steps]
-      else:
-        for name, value in wire.parse_example(record).items():
-          merged_context[tag + name] = _encodeable(value)
+      except Exception:  # noqa: BLE001 - plain-Example-only wire quirks
+        context, feature_lists = wire.parse_example(record), {}
+      parsed.append(('{}{}/'.format(prefix, i), context, feature_lists))
+  sequence = any(feature_lists for _, _, feature_lists in parsed)
+  merged_context: Dict[str, object] = {}
+  merged_lists: Dict[str, list] = {}
+  for tag, context, feature_lists in parsed:
+    for name, value in context.items():
+      merged_context[tag + name] = _encodeable(value)
+    for name, steps in feature_lists.items():
+      merged_lists[tag + name] = [_encodeable(s) for s in steps]
   if sequence:
     return wire.build_sequence_example(merged_context, merged_lists)
   return wire.build_example(merged_context)

@@ -1,0 +1,125 @@
+"""The dynamics-bearing RL cycle: collect -> replay -> train -> eval.
+
+VERDICT r3 item 8: the pose toy env is a one-step bandit, so no policy
+ever faced environment DYNAMICS. The pusher env has momentum, process
+noise, and wall contact; this test closes the full loop through
+rl/collect_eval.py and asserts the trained critic policy beats random —
+a learning curve over real state transitions.
+"""
+
+import functools
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data.writer import TFRecordReplayWriter
+from tensor2robot_tpu.research import pusher_env
+from tensor2robot_tpu.rl.run_env import run_env
+from tensor2robot_tpu.rl.collect_eval import collect_eval_loop
+
+
+class TestPusherDynamics:
+
+  def test_momentum_and_contact(self):
+    env = pusher_env.PusherEnv(seed=0, noise_std=0.0)
+    obs = env.reset()
+    # Push right twice: velocity builds up (momentum).
+    _, _, _, _ = env.step([1.0, 0.0])
+    v1 = env._v[0]
+    _, _, _, _ = env.step([1.0, 0.0])
+    v2 = env._v[0]
+    assert v2 > v1 > 0
+    # Coast with zero action: still moving (momentum), decaying (damping).
+    _, _, _, _ = env.step([0.0, 0.0])
+    assert 0 < env._v[0] < v2
+    # Drive into the right wall: position clamps, velocity zeroes.
+    for _ in range(30):
+      env._t = 0  # keep the episode alive while driving
+      _, _, _, _ = env.step([1.0, 0.0])
+    assert env._p[0] == pytest.approx(1.0)
+    assert env._v[0] == 0.0
+
+  def test_noise_makes_transitions_stochastic(self):
+    env = pusher_env.PusherEnv(seed=1)
+    env.reset()
+    p = env._p.copy()
+    v = env._v.copy()
+    a, b = env.step([0.3, -0.2])[0], None
+    env._p, env._v, env._t = p, v, 0
+    b = env.step([0.3, -0.2])[0]
+    assert not np.allclose(a, b)  # same state+action, different next state
+
+
+class TestPusherLearningCurve:
+
+  def test_trained_critic_policy_beats_random(self, tmp_path):
+    import jax
+
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.predictors.checkpoint_predictor import (
+        CheckpointPredictor,
+    )
+    from tensor2robot_tpu.trainer import Trainer
+
+    root = str(tmp_path / 'cycle')
+    run_agent_fn = functools.partial(
+        run_env,
+        episode_to_transitions_fn=pusher_env.episode_to_transitions_pusher,
+        replay_writer=TFRecordReplayWriter(),
+        close_env=False)
+
+    # 1. Collect with the random policy through the collect/eval loop.
+    collect_eval_loop(
+        collect_env=pusher_env.PusherEnv(seed=2),
+        eval_env=None,
+        policy_class=lambda: pusher_env.PusherRandomPolicy(seed=3),
+        num_collect=80,
+        num_eval=0,
+        run_agent_fn=run_agent_fn,
+        root_dir=root)
+    records = glob.glob(os.path.join(root, 'policy_collect', '*'))
+    assert records, 'collect wrote no replay records'
+
+    # 2. Train the critic on the replay records.
+    import functools as ft
+
+    from tensor2robot_tpu.models import optimizers as opt_lib
+    model = pusher_env.PusherCriticModel(
+        device_type='cpu',
+        create_optimizer_fn=ft.partial(opt_lib.create_adam_optimizer,
+                                       learning_rate=3e-3))
+    generator = DefaultRecordInputGenerator(
+        file_patterns=os.path.join(root, 'policy_collect', '*'),
+        batch_size=64)
+    model_dir = str(tmp_path / 'run')
+    trainer = Trainer(model, model_dir,
+                      mesh=parallel.create_mesh(
+                          {'data': 1}, devices=jax.devices()[:1]),
+                      async_checkpoints=False, save_checkpoints_steps=200)
+    trainer.train(generator, max_train_steps=200)
+    trainer.close()
+
+    # 3. Eval: greedy-over-Q policy vs random, identical env seeds.
+    def _mean_reward(policy, seed):
+      env = pusher_env.PusherEnv(seed=seed)
+      rewards = run_env(
+          env, policy=policy, num_episodes=30, tag='eval',
+          root_dir=None, close_env=True)
+      return float(np.mean(rewards))
+
+    predictor = CheckpointPredictor(
+        pusher_env.PusherCriticModel(device_type='cpu'), model_dir,
+        timeout=5.0)
+    critic_policy = pusher_env.PusherCriticPolicy(predictor, seed=4)
+    assert critic_policy.restore()
+    trained = _mean_reward(critic_policy, seed=100)
+    rand = _mean_reward(pusher_env.PusherRandomPolicy(seed=5), seed=100)
+    predictor.close()
+    # Episode reward is a sum of 8 in-[0,1] per-step rewards; a policy
+    # that exploits the dynamics clears random by a wide margin.
+    assert trained > rand + 0.4, (trained, rand)
