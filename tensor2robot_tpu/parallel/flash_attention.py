@@ -356,8 +356,16 @@ def flash_attention_carry(q, k, v, o, m, l, q_offset, k_offset,
 # forward's (1024, 1024) tuning holds one [bq, bk] f32 score block; the
 # backward holds four ([s, p, dp, ds]) plus two accumulator blocks, so the
 # same sizes would 4x the peak VMEM and OOM at the L=32k headline case.
-BWD_BLOCK_Q = 512
-BWD_BLOCK_K = 512
+# Defaults are L-adaptive from a v5e sweep (B=1 H=8 D=128 causal, fwd+bwd
+# chained): (256, 256) wins at L<=4k (7.1 vs 10.5 ms); (512, 1024) wins
+# from 8k up (18.8/19.4/58.0 ms at 8k/16k/32k vs 20.4/20.4/60.8 for the
+# flat 512s).
+
+
+def _bwd_default_blocks(l_q: int, l_k: int):
+  # Keyed on the LARGER side so cross-attention with mismatched lengths
+  # lands in the regime its bigger grid actually runs in.
+  return (256, 256) if max(l_q, l_k) <= 4096 else (512, 1024)
 
 
 def _bwd_p_ds(q, k, v, do, lse, delta, *, scale, causal, q_base, k_base,
@@ -566,13 +574,14 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, block_q_bwd,
 
   Until round 4 this was an XLA lax.scan recompute; it is now the same
   kernel family as the forward, with causal block skip and its own block
-  sizes (BWD_BLOCK_Q/K defaults — the forward's 1024 would 4x the
+  sizes (_bwd_default_blocks — the forward's 1024 would 4x the
   backward's VMEM working set and OOM the L=32k case)."""
   q, k, v, out, lse = residuals
   l_q = q.shape[1]
   l_k = k.shape[1]
-  bq = _dividing_block_or_raise(min(block_q_bwd or BWD_BLOCK_Q, l_q), l_q)
-  bk = _dividing_block_or_raise(min(block_k_bwd or BWD_BLOCK_K, l_k), l_k)
+  default_bq, default_bk = _bwd_default_blocks(l_q, l_k)
+  bq = _dividing_block_or_raise(min(block_q_bwd or default_bq, l_q), l_q)
+  bk = _dividing_block_or_raise(min(block_k_bwd or default_bk, l_k), l_k)
   dq, dk, dv = _flash_bwd_pallas(
       q, k, v, out, lse, d_out, scale=scale, causal=causal,
       block_q=bq, block_k=bk, interpret=interpret)
