@@ -194,6 +194,18 @@ def _bench_transfer(sample_batch) -> float:
   return nbytes / dt / 1e6
 
 
+def _sync(state):
+  """Fetch a scalar output of the step executable to synchronize timing.
+
+  jax.block_until_ready can return before execution finishes on this
+  environment's tunneled chip; fetching any output buffer of the jitted
+  step (state.step is the cheapest) cannot.
+  """
+  import jax
+
+  return int(jax.device_get(state.step))
+
+
 def _trainer_step_setup(model, mesh, batch_size, tmp, sample_batch=None):
   """Shared: init state + compiled step + one resident sharded batch.
 
@@ -315,12 +327,12 @@ def _bench_e2e_from_disk(model_factory, mesh, batch_size: int,
 
       batch = _next_device_batch()
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       t0 = time.time()
       for _ in range(n_steps):
         batch = _next_device_batch()
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       dt = time.time() - t0
       stop.append(True)
       with lock:
@@ -368,12 +380,12 @@ def _bench_qtopt(mesh, on_tpu: bool):
         except Exception:  # noqa: BLE001 — cost analysis is best-effort
           pass
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-        jax.block_until_ready(state.params)
+        _sync(state)
         t0 = time.time()
         for _ in range(n_steps):
           state, _ = step_fn(state, batch['features'], batch['labels'],
                              rng)
-        jax.block_until_ready(state.params)
+        _sync(state)
         dt = time.time() - t0
       finally:
         trainer.close()
@@ -425,11 +437,11 @@ def _grasp2vec_attempt(model, mesh, batch_size, n_steps):
       except Exception:  # noqa: BLE001
         pass
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       t0 = time.time()
       for _ in range(n_steps):
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       dt = time.time() - t0
     finally:
       trainer.close()
@@ -451,11 +463,11 @@ def _bench_seq2act(mesh, on_tpu: bool):
         model, mesh, batch_size, tmp)
     try:
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       t0 = time.time()
       for _ in range(n_steps):
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       dt = time.time() - t0
     finally:
       trainer.close()
@@ -598,7 +610,7 @@ def _bench_qtopt_convergence(mesh, on_tpu: bool, batch_size: int = 64,
       batch = trainer._put_batch({'features': first[0].to_dict(),
                                   'labels': first[1].to_dict()})
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       _accuracy(state)
 
       elapsed = 0.0
@@ -612,7 +624,7 @@ def _bench_qtopt_convergence(mesh, on_tpu: bool, batch_size: int = 64,
                                       'labels': labels.to_dict()})
           state, _ = step_fn(state, batch['features'], batch['labels'],
                              rng)
-        jax.block_until_ready(state.params)
+        _sync(state)
         elapsed += time.time() - t0
         steps += 10
         acc = _accuracy(state)
@@ -647,11 +659,11 @@ def _bench_seq2act_long(mesh, on_tpu: bool) -> float:
         model, mesh, batch_size, tmp)
     try:
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       t0 = time.time()
       for _ in range(n_steps):
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       dt = (time.time() - t0) / n_steps
     finally:
       trainer.close()
@@ -758,7 +770,7 @@ def _bench_maml_inner_step(mesh):
           {'features': features.to_dict(), 'labels': labels.to_dict()},
           mesh)
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      jax.block_until_ready(state.params)
+      _sync(state)
       n_steps = 20
       times = []
       for _ in range(5):
@@ -766,7 +778,7 @@ def _bench_maml_inner_step(mesh):
         for _ in range(n_steps):
           state, _ = step_fn(state, batch['features'], batch['labels'],
                              rng)
-        jax.block_until_ready(state.params)
+        _sync(state)
         times.append((time.time() - t0) / n_steps)
       times.sort()
     finally:
