@@ -104,6 +104,11 @@ def register_all() -> None:
   register(maml_model.MAMLRegressionModel, 'MAMLRegressionModel')
   register(maml_inner_loop.MAMLInnerLoopGradientDescent,
            'MAMLInnerLoopGradientDescent')
+  from tensor2robot_tpu.preprocessors import device_decode
+  register(device_decode.DeviceDecodePreprocessor,
+           'DeviceDecodePreprocessor')
+  register(device_decode.wrap_model_with_device_decode,
+           'wrap_model_with_device_decode')
   register(meta_preproc.MAMLPreprocessorV2, 'MAMLPreprocessorV2')
   register(meta_preproc.FixedLenMetaExamplePreprocessor,
            'FixedLenMetaExamplePreprocessor')

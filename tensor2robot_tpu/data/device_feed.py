@@ -43,6 +43,17 @@ class SparseCoefFeed:
         DeviceDecodePreprocessor,
     )
 
+    # Unwrap decorators (e.g. the TPU Bfloat16PreprocessorWrapper, which
+    # train_eval_model installs OUTSIDE the device-decode wrapper) via
+    # their ``preprocessor`` property.
+    seen = 0
+    while (not isinstance(preprocessor, DeviceDecodePreprocessor)
+           and seen < 8):
+      nxt = getattr(type(preprocessor), 'preprocessor', None)
+      if nxt is None:
+        return None
+      preprocessor = preprocessor.preprocessor
+      seen += 1
     if not isinstance(preprocessor, DeviceDecodePreprocessor):
       return None
     spec = preprocessor.raw_in_feature_specification('train')
@@ -58,11 +69,17 @@ class SparseCoefFeed:
     cache_key = (height, width, tuple(shape))
     fn = self._jit_cache.get(cache_key)
     if fn is None:
-      # No donation: the uint8/int8 inputs can't alias the int16 outputs,
-      # so donating only produces "donated buffers were not usable" spam.
+      # Explicit batch-sharded outputs: the train step is jitted with
+      # explicit in_shardings, and on a multi-device mesh an INFERRED
+      # unpack output sharding need not match it (jax then errors
+      # instead of resharding). No donation: the uint8/int8 inputs can't
+      # alias the int16 outputs, so donating only produces "donated
+      # buffers were not usable" spam.
+      out_sharding = sharding_lib.batch_sharding(self._mesh)
       fn = jax.jit(
           lambda sd, sv: jpeg_device.unpack_sparse_coefficients(
-              sd, sv, height, width))
+              sd, sv, height, width),
+          out_shardings=out_sharding)
       self._jit_cache[cache_key] = fn
     return fn
 

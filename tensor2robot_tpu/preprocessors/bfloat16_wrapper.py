@@ -67,6 +67,21 @@ class Bfloat16PreprocessorWrapper(AbstractPreprocessor):
       labels = self._cast(labels, self.get_out_label_specification(mode))
     return features, labels
 
+  def _cast_in(self, tensors, in_spec) -> SpecStruct:
+    """Casts to bf16 exactly where the inner's in-spec declares it,
+    passing unknown keys through untouched (unlike _cast, which also
+    filters to the spec's keys)."""
+    flat_spec = specs_lib.flatten_spec_structure(in_spec)
+    flat = specs_lib.flatten_spec_structure(tensors)
+    out = SpecStruct()
+    for key in flat:
+      value = flat[key]
+      if key in flat_spec and flat_spec[key].dtype == bfloat16:
+        import jax.numpy as jnp
+        value = jnp.asarray(value).astype(bfloat16)
+      out[key] = value
+    return out
+
   def _cast(self, tensors, out_spec) -> SpecStruct:
     """Keeps required tensors, casting f32->bf16 where the out-spec says so."""
     flat_spec = specs_lib.flatten_spec_structure(out_spec)
@@ -82,5 +97,43 @@ class Bfloat16PreprocessorWrapper(AbstractPreprocessor):
       out[key] = value
     return out
 
-  # preprocess() is inherited: the base validate -> _preprocess_fn ->
-  # validate template already runs against this wrapper's re-typed specs.
+  def preprocess(self, features, labels, mode: str, rng=None):
+    """Validate -> transform -> cast; delegates wholesale to inners that
+    own their full pipeline.
+
+    A wrapped preprocessor that OVERRIDES preprocess() (e.g.
+    DeviceDecodePreprocessor, whose override accepts both sparse streams
+    and dense coefficient tensors and forbids _preprocess_fn) gets called
+    through its public entry point; everything else runs the inherited
+    validate -> _preprocess_fn -> validate template against this
+    wrapper's re-typed specs.
+    """
+    inner_cls = type(self._preprocessor)
+    if inner_cls.preprocess is not AbstractPreprocessor.preprocess:
+      # The host pipeline ships f32 where the inner asks for bf16 (this
+      # wrapper's in-spec re-typing); restore the inner's declared input
+      # dtypes before handing off, leaving keys the inner's in-spec does
+      # not know (e.g. feed-converted dense coefficient tensors) intact.
+      features = self._cast_in(
+          features, self._preprocessor.get_in_feature_specification(mode))
+      if labels is not None:
+        labels = self._cast_in(
+            labels, self._preprocessor.get_in_label_specification(mode))
+      features, labels = self._preprocessor.preprocess(features, labels,
+                                                       mode, rng=rng)
+      features = self._cast(features,
+                            self.get_out_feature_specification(mode))
+      if labels is not None:
+        labels = self._cast(labels, self.get_out_label_specification(mode))
+      return features, labels
+    return super().preprocess(features, labels, mode, rng=rng)
+
+  def __getattr__(self, name):
+    """Forwards the wrapped preprocessor's extra surface (decorator
+    contract): e.g. DeviceDecodePreprocessor's
+    ``raw_in_feature_specification`` / ``sparse`` / ``image_keys``, which
+    the input generators introspect to plan the native coef stream. Only
+    public attributes forward; missing privates raise normally."""
+    if name.startswith('_'):
+      raise AttributeError(name)
+    return getattr(self.__dict__['_preprocessor'], name)

@@ -264,3 +264,17 @@ class TestReferenceConfigParity:
     from tensor2robot_tpu.trainer import latest_checkpoint_step
     assert latest_checkpoint_step(model_dir) == 2
     assert results['eval_metrics']
+
+  def test_qtopt_sparse_config_wires_split_decode(self):
+    from tensor2robot_tpu import config
+    config.register_framework_configurables()
+    config.add_config_file_search_path(REPO_ROOT)
+    config.parse_config_files_and_bindings(
+        [os.path.join(REPO_ROOT, 'tensor2robot_tpu/research/qtopt/configs/'
+                      'train_qtopt_sparse.gin')], [])
+    model = config.query_parameter('train_eval_model.t2r_model')
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    assert isinstance(model.preprocessor, DeviceDecodePreprocessor)
+    assert model.preprocessor.sparse

@@ -408,3 +408,37 @@ class TestDeviceDecodePreprocessor:
         lambda mode: SpecStruct())
     with pytest.raises(ValueError, match='no coef-eligible'):
       DeviceDecodePreprocessor(pre)
+
+  def test_train_eval_model_wraps_bf16_outside_sparse(self, tmp_path):
+    """The production config path: train_eval_model on a TPU-typed model
+    installs Bfloat16PreprocessorWrapper OUTSIDE the device-decode
+    wrapper. The bf16 decorator must forward the device-decode surface
+    (raw specs / sparse flag) so the generator still plans the native
+    sparse stream, and must delegate preprocess() wholesale (round-4
+    regression: this configuration silently fell back to the Python
+    parser and crashed on the sparse in-specs)."""
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRecordInputGenerator,
+    )
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
+    from tensor2robot_tpu.trainer import train_eval_model
+
+    model = self._image_model()
+    model._device_type = 'tpu'  # force the bf16 wrap on the CPU backend
+    path = str(tmp_path / 'imgs.tfrecord')
+    self._write_records(path)
+    model.set_preprocessor(
+        DeviceDecodePreprocessor(model.preprocessor, sparse=True))
+    generator = DefaultRecordInputGenerator(file_patterns=path,
+                                            batch_size=4)
+    results = train_eval_model(
+        t2r_model=model,
+        model_dir=str(tmp_path / 'run'),
+        input_generator_train=generator,
+        max_train_steps=2,
+        mesh=parallel.create_mesh({'data': 1}, devices=jax.devices()[:1]),
+        async_checkpoints=False)
+    assert int(jax.device_get(results['state'].step)) == 2
