@@ -66,6 +66,9 @@
 #if defined(__SSE4_2__)
 #include <nmmintrin.h>
 #endif
+#if defined(__SSE2__)
+#include <emmintrin.h>
+#endif
 
 namespace {
 
@@ -509,7 +512,10 @@ std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
   }
   long long cur = -1, cnt = 0;
   bool overflow = false;
-  auto emit = [&](long long pos, int v) {
+  // Slow path: long gaps (>255) and wide values (|v|>127) via skip /
+  // continuation entries. Rare — the inlined fast path in the scan loop
+  // below handles the ~99% case with two stores.
+  auto emit_slow = [&](long long pos, int v) {
     long long diff = pos - cur;
     while (diff > 255) {
       if (cnt >= cap) { overflow = true; return; }
@@ -534,6 +540,17 @@ std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
     }
     cur = pos;
   };
+  auto emit = [&](long long pos, int v) {
+    long long diff = pos - cur;
+    if (diff <= 255 && v >= -128 && v <= 127 && cnt < cap) {
+      sd[cnt] = (uint8_t)diff;
+      sv[cnt] = (int8_t)v;
+      cnt++;
+      cur = pos;
+      return;
+    }
+    emit_slow(pos, v);
+  };
   int bw[3] = {f.w / 8, f.w / 16, f.w / 16};
   int bh[3] = {f.h / 8, f.h / 16, f.h / 16};
   long long base = 0;
@@ -552,11 +569,33 @@ std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
       for (int bc = 0; bc < bw[comp] && !overflow; bc++) {
         const JCOEF* block = rows[0][bc];
         long long block_base = base + ((long long)br * bw[comp] + bc) * 64;
-        // Zero coefficients dominate (~88%); scan 4 at a time via uint64
-        // group checks instead of per-coefficient branches (measured
-        // ~1.5x on the whole entropy+pack path for camera frames).
+        // Zero coefficients dominate (~88%); scan for nonzeros with wide
+        // compares instead of per-coefficient branches. With the
+        // two-store emit fast path this cut the sparse-pack overhead vs
+        // plain coef mode from ~0.6 ms to ~0.1 ms per 512x640 frame
+        // (580 -> 925 ex/s single-worker on the bench host).
         static_assert(sizeof(JCOEF) == 2,
                       "group scan assumes 16-bit coefficients");
+#if defined(__SSE2__)
+        for (int g = 0; g < 4; g++) {
+          __m128i a = _mm_loadu_si128((const __m128i*)(block + g * 16));
+          __m128i b = _mm_loadu_si128(
+              (const __m128i*)(block + g * 16 + 8));
+          __m128i zero = _mm_setzero_si128();
+          // Per-16-bit-lane zero masks, packed to one byte per lane.
+          uint32_t z = (uint32_t)_mm_movemask_epi8(
+              _mm_packs_epi16(_mm_cmpeq_epi16(a, zero),
+                              _mm_cmpeq_epi16(b, zero)));
+          uint32_t nz = ~z & 0xFFFFu;  // bit i set <=> block[g*16+i] != 0
+          while (nz) {
+            int k = g * 16 + __builtin_ctz(nz);
+            nz &= nz - 1;
+            emit(block_base + k, block[k]);
+            if (overflow) break;
+          }
+          if (overflow) break;
+        }
+#else
         for (int g = 0; g < 16; g++) {
           uint64_t group;
           memcpy(&group, block + g * 4, 8);
@@ -569,6 +608,7 @@ std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
           }
           if (overflow) break;
         }
+#endif
       }
     }
     base += (long long)bh[comp] * bw[comp] * 64;
