@@ -206,6 +206,18 @@ def _sync(state):
   return int(jax.device_get(state.step))
 
 
+def _timed_median(run_once, reps: int = 5):
+  """(median_seconds, spread_seconds) over reps of run_once() (which must
+  block until the measured work is done — see _sync)."""
+  times = []
+  for _ in range(reps):
+    t0 = time.time()
+    run_once()
+    times.append(time.time() - t0)
+  times.sort()
+  return times[len(times) // 2], times[-1] - times[0]
+
+
 def _trainer_step_setup(model, mesh, batch_size, tmp, sample_batch=None):
   """Shared: init state + compiled step + one resident sharded batch.
 
@@ -464,16 +476,25 @@ def _bench_seq2act(mesh, on_tpu: bool):
     try:
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
       _sync(state)
-      t0 = time.time()
-      for _ in range(n_steps):
-        state, _ = step_fn(state, batch['features'], batch['labels'], rng)
-      _sync(state)
-      dt = time.time() - t0
+
+      def _run():
+        nonlocal state
+        for _ in range(n_steps):
+          state, _ = step_fn(state, batch['features'], batch['labels'],
+                             rng)
+        _sync(state)
+
+      # Median of 5: the ~15 ms step is small enough that dispatch
+      # variance swung single measurements ~35% between rounds (VERDICT
+      # r3 item 4's discipline, applied to this field too).
+      median_s, spread_s = _timed_median(_run)
     finally:
       trainer.close()
-  episodes_per_sec = batch_size * n_steps / dt
+  episodes_per_sec = batch_size * n_steps / median_s
+  # First-order rate spread from the time spread.
+  spread = batch_size * n_steps * spread_s / (median_s * median_s)
   tokens = model.episode_length * 8  # tokens_per_frame default
-  return episodes_per_sec, episodes_per_sec * tokens
+  return episodes_per_sec, episodes_per_sec * tokens, spread
 
 
 def _write_rule_records(path: str, feature_spec, label_spec,
@@ -718,13 +739,13 @@ def _bench_cem_latency(model, mesh):
 
   key = jax.random.PRNGKey(0)
   float(chained(variables, obs, key))  # compile + warm
-  times = []
-  for r in range(5):
-    t0 = time.time()
-    float(chained(variables, obs, jax.random.fold_in(key, 1000 + r)))
-    times.append((time.time() - t0) / n * 1000.0)
-  times.sort()
-  return times[len(times) // 2], times[-1] - times[0]
+  reps = iter(range(5))
+
+  def _run():
+    float(chained(variables, obs, jax.random.fold_in(key, 1000 + next(reps))))
+
+  median_s, spread_s = _timed_median(_run)
+  return (median_s / n) * 1000.0, (spread_s / n) * 1000.0
 
 
 def _bench_maml_inner_step(mesh):
@@ -772,20 +793,20 @@ def _bench_maml_inner_step(mesh):
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
       _sync(state)
       n_steps = 20
-      times = []
-      for _ in range(5):
-        t0 = time.time()
+
+      def _run():
+        nonlocal state
         for _ in range(n_steps):
           state, _ = step_fn(state, batch['features'], batch['labels'],
                              rng)
         _sync(state)
-        times.append((time.time() - t0) / n_steps)
-      times.sort()
+
+      # Median of 5 runs + spread: small-step metrics drifted 30% between
+      # rounds from shared-chip variance (VERDICT r3 item 4).
+      median_s, spread_s = _timed_median(_run)
     finally:
       trainer.close()
-  # Median of 5 runs + spread: small-step metrics drifted 30% between
-  # rounds from shared-chip variance (VERDICT r3 item 4).
-  return times[2] * 1000.0, (times[-1] - times[0]) * 1000.0
+  return (median_s / n_steps) * 1000.0, (spread_s / n_steps) * 1000.0
 
 
 def main():
@@ -921,8 +942,9 @@ def main():
     out['grasp2vec_samples_per_sec'] = -1.0
 
   try:
-    s2a_rate, s2a_tokens = _bench_seq2act(mesh, on_tpu)
+    s2a_rate, s2a_tokens, s2a_spread = _bench_seq2act(mesh, on_tpu)
     out['seq2act_episodes_per_sec'] = round(s2a_rate, 2)
+    out['seq2act_episodes_per_sec_spread'] = round(s2a_spread, 2)
     out['seq2act_tokens_per_sec'] = round(s2a_tokens, 1)
   except Exception:  # noqa: BLE001
     out['seq2act_episodes_per_sec'] = -1.0
