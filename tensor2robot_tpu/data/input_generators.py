@@ -241,7 +241,9 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
                             False))
       plan = native_loader.plan_for_specs(
           self._raw_feature_spec, self._label_spec,
-          image_mode='coef_sparse' if sparse else 'coef')
+          image_mode='coef_sparse' if sparse else 'coef',
+          sparse_density=float(getattr(self._device_decode_preprocessor,
+                                       'sparse_density', 0.5)))
       if plan is None:
         raise ValueError(
             'DeviceDecodePreprocessor requires the native loader fast path '

@@ -65,7 +65,8 @@ def sparse_coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
   return out
 
 
-def wrap_model_with_device_decode(model=None, sparse: bool = True):
+def wrap_model_with_device_decode(model=None, sparse: bool = True,
+                                  sparse_density: float = 0.5):
   """Config-surface helper: switch a model to the split-decode input path.
 
   Gin usage (the one-line production wiring)::
@@ -80,7 +81,8 @@ def wrap_model_with_device_decode(model=None, sparse: bool = True):
   if model is None:
     raise ValueError('wrap_model_with_device_decode requires a model.')
   model.set_preprocessor(
-      DeviceDecodePreprocessor(model.preprocessor, sparse=sparse))
+      DeviceDecodePreprocessor(model.preprocessor, sparse=sparse,
+                               sparse_density=sparse_density))
   return model
 
 
@@ -96,11 +98,17 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
   sparse features directly for tests and numpy pipelines.
   """
 
-  def __init__(self, inner: AbstractPreprocessor, sparse: bool = False):
+  def __init__(self, inner: AbstractPreprocessor, sparse: bool = False,
+               sparse_density: float = 0.5):
     super().__init__(inner._model_feature_specification_fn,
                      inner._model_label_specification_fn)
     self._inner = inner
     self.sparse = bool(sparse)
+    # Entry capacity as a fraction of the total coefficient count; the
+    # input generator passes it to the native loader plan. Camera frames
+    # run ~12-14% nonzero; raise toward 1.0 for unusually dense imagery
+    # (the loader errors with a clear message on overflow).
+    self.sparse_density = float(sparse_density)
     keys = self.image_keys('train')
     if not keys:
       raise ValueError(

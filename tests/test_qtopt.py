@@ -276,7 +276,12 @@ class TestFullFidelitySystems:
   the complete filesystem transport contract with no synthetic resident
   batches anywhere."""
 
-  def test_disk_records_to_cem_action(self, tmp_path):
+  @pytest.mark.parametrize('sparse', [False, True])
+  def test_disk_records_to_cem_action(self, tmp_path, sparse):
+    """sparse=True runs the production input wiring: the learner trains
+    over bucketed sparse DCT streams (DeviceDecodePreprocessor +
+    SparseCoefFeed) while the robot side serves the SAME export artifact
+    with a plain model — params are wrapper-independent."""
     from tensor2robot_tpu.data import tfrecord
     from tensor2robot_tpu.data.parser import build_example_for_specs
     from tensor2robot_tpu.data.input_generators import (
@@ -285,6 +290,9 @@ class TestFullFidelitySystems:
     from tensor2robot_tpu.export.exporters import LatestModelExporter
     from tensor2robot_tpu.policies import DeviceCEMPolicy
     from tensor2robot_tpu.predictors import ExportedModelPredictor
+    from tensor2robot_tpu.preprocessors.device_decode import (
+        DeviceDecodePreprocessor,
+    )
     from tensor2robot_tpu.specs.struct import SpecStruct
     from tensor2robot_tpu.utils.image import numpy_to_image_string
 
@@ -293,14 +301,26 @@ class TestFullFidelitySystems:
         ModeKeys.TRAIN)
     in_labels = model.preprocessor.get_in_label_specification(ModeKeys.TRAIN)
     spec = SpecStruct(f=in_features, l=in_labels)
+    if sparse:
+      model.set_preprocessor(
+          DeviceDecodePreprocessor(model.preprocessor, sparse=True))
 
     # Collect side: 48 grasp attempts as reference-format records — full
     # 512x640 JPEG camera frames, grasp params, success label.
     rng = np.random.RandomState(0)
     records = []
     for i in range(48):
-      frame = np.tile(
-          rng.randint(0, 255, (512, 640, 1), dtype=np.uint8), (1, 1, 3))
+      # Camera-like content (gradient + blocks + mild noise), not uniform
+      # noise: noise is the Huffman worst case and overflows the sparse
+      # mode's default entry capacity by design.
+      x = np.linspace(0, 1, 640)
+      y = np.linspace(0, 1, 512)
+      scene = (np.outer(y, x)[..., None] *
+               rng.randint(100, 255, 3)).astype(np.float32)
+      r0, c0 = rng.randint(0, 432), rng.randint(0, 540)
+      scene[r0:r0 + 80, c0:c0 + 100] = rng.randint(0, 255, 3)
+      scene += rng.randn(512, 640, 1) * 6
+      frame = np.clip(scene, 0, 255).astype(np.uint8)
       values = SpecStruct()
       for key in in_features:
         if key == 'state/image':
@@ -321,6 +341,10 @@ class TestFullFidelitySystems:
     generator.set_specification_from_model(model, ModeKeys.TRAIN)
     assert generator._native_iterator(ModeKeys.TRAIN, 1, 0, 1, 0) is not None, (
         'QT-Opt in-specs must ride the native C++ loader fast path')
+    if sparse:
+      feats, _ = next(generator.create_dataset_iterator(
+          mode=ModeKeys.EVAL, num_epochs=1))
+      assert 'state/image/sd' in feats, 'sparse stream keys expected'
     trainer = Trainer(model, str(tmp_path / 'run'), async_checkpoints=False,
                       save_checkpoints_steps=10**9, log_every_n_steps=10**9)
     try:
