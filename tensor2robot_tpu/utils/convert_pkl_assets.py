@@ -1,0 +1,205 @@
+"""Migrate reference pickle-based assets to ``t2r_assets.pbtxt``.
+
+Parity: the reference's one-shot migration CLI
+(``utils/convert_pkl_assets_to_proto_assets.py:40`` ``convert()``) and the
+pickle writers it retires (``utils/tensorspec_utils.py:1698-1729``
+``write_input_spec_to_file`` / ``write_global_step_to_file``).
+
+The reference unpickles by importing its live TF1 classes. Here a
+*restricted* unpickler rebuilds our :class:`TensorSpec` / :class:`SpecStruct`
+directly from the opcode stream instead, so asset directories written by the
+reference (``input_specs.pkl`` + optional ``global_step.pkl``) migrate
+
+* without TF1 or the ``tensor2robot`` package installed, and
+* without executing arbitrary pickle globals — only an allowlist of
+  spec/shape/dtype constructors resolves; anything else raises
+  ``pickle.UnpicklingError`` naming the offending global.
+
+The allowlist covers exactly what the reference's writers can emit: its
+``ExtendedTensorSpec`` (pickled via ``__reduce__`` as a 9-tuple of
+constructor args — ``utils/tensorspec_utils.py:278``), its
+``TensorSpecStruct`` (an OrderedDict subclass with flat ``a/b`` paths),
+plain ``tf.TensorSpec``, and TF's ``TensorShape``/``Dimension``/``as_dtype``
+reduction hooks.
+"""
+
+import collections
+import io
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+from tensor2robot_tpu.specs.assets import T2R_ASSETS_FILENAME
+from tensor2robot_tpu.specs.assets import write_t2r_assets_to_file
+from tensor2robot_tpu.specs.struct import SpecStruct
+from tensor2robot_tpu.specs.tensor_spec import TensorSpec
+
+
+# -- Shims the restricted unpickler substitutes for reference globals -------
+
+
+def _tensor_shape(dims=None) -> Tuple[Optional[int], ...]:
+  """tf.TensorShape reduces to (TensorShape, ([Dimension...],))."""
+  if dims is None:
+    return ()
+  return tuple(dims)
+
+
+def _dimension(value=None) -> Optional[int]:
+  """tf Dimension(v) -> the plain int (or None for unknown)."""
+  return None if value is None else int(value)
+
+
+def _as_dtype(name):
+  """tf dtypes reduce to (as_dtype, ('float32',)); keep the name string.
+
+  Our ``TensorSpec`` constructor canonicalizes dtype names itself
+  (``specs/tensor_spec.py`` ``canonical_dtype``), so the shim only has to
+  carry the name through the pickle graph.
+  """
+  return name
+
+
+def _extended_tensor_spec(shape, dtype, name=None, is_optional=None,
+                          is_sequence=False, is_extracted=False,
+                          data_format=None, dataset_key=None,
+                          varlen_default_value=None) -> TensorSpec:
+  """The reference ExtendedTensorSpec __reduce__ arg order, verbatim."""
+  return TensorSpec(
+      shape=_tensor_shape(shape) if not isinstance(shape, tuple) else shape,
+      dtype=dtype, name=name, is_optional=is_optional,
+      is_sequence=is_sequence, is_extracted=is_extracted,
+      data_format=data_format, dataset_key=dataset_key,
+      varlen_default_value=varlen_default_value)
+
+
+def _plain_tensor_spec(shape, dtype, name=None) -> TensorSpec:
+  return _extended_tensor_spec(shape, dtype, name)
+
+
+class _SpecStructShim(collections.OrderedDict):
+  """Stand-in for the reference TensorSpecStruct during unpickling.
+
+  OrderedDict subclasses pickle as ``cls()`` + SETITEMS + an instance-dict
+  BUILD; the reference class keeps internal attributes in ``__dict__`` that
+  have no meaning here, so the state is dropped.
+  """
+
+  def __setstate__(self, state):  # noqa: ARG002 - reference-internal state
+    pass
+
+
+_ALLOWED_GLOBALS = {
+    ('collections', 'OrderedDict'): collections.OrderedDict,
+    ('tensor2robot.utils.tensorspec_utils', 'ExtendedTensorSpec'):
+        _extended_tensor_spec,
+    ('tensor2robot.utils.tensorspec_utils', 'TensorSpecStruct'):
+        _SpecStructShim,
+    ('tensorflow.python.framework.tensor_shape', 'TensorShape'):
+        _tensor_shape,
+    ('tensorflow.python.framework.tensor_shape', 'Dimension'): _dimension,
+    ('tensorflow.python.framework.tensor_shape', 'as_dimension'): _dimension,
+    ('tensorflow.python.framework.dtypes', 'as_dtype'): _as_dtype,
+    ('tensorflow.python.framework.tensor_spec', 'TensorSpec'):
+        _plain_tensor_spec,
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+
+  def find_class(self, module: str, name: str):
+    try:
+      return _ALLOWED_GLOBALS[(module, name)]
+    except KeyError:
+      raise pickle.UnpicklingError(
+          'Refusing to resolve pickle global {}.{} — only reference '
+          'tensorspec assets can be converted (allowed: {}).'.format(
+              module, name,
+              sorted('{}.{}'.format(m, n) for m, n in _ALLOWED_GLOBALS)))
+
+
+def _restricted_load(data: bytes) -> Any:
+  return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# -- Public API --------------------------------------------------------------
+
+
+def _to_spec_struct(obj: Any) -> SpecStruct:
+  """Reference spec containers (TensorSpecStruct / dicts) -> our SpecStruct."""
+  if isinstance(obj, TensorSpec):
+    # A bare spec pickled at top level; wrap it like the reference's
+    # flatten would (single anonymous path).
+    return SpecStruct(**{obj.name or 'value': obj})
+  if isinstance(obj, collections.abc.Mapping):
+    # TensorSpecStruct keys are flat 'a/b' paths; SpecStruct.__setitem__
+    # accepts the same path syntax and splices nested mappings itself.
+    out = SpecStruct()
+    for key, value in obj.items():
+      out[key] = value
+    return out
+  raise ValueError(
+      'Unsupported pickled spec container: {!r}'.format(type(obj)))
+
+
+def load_input_spec_from_pkl(filename: str):
+  """Reads a reference ``input_specs.pkl`` -> (feature_spec, label_spec).
+
+  Mirrors ``load_input_spec_from_file`` (ref tensorspec_utils.py:1705):
+  the payload is ``{'in_feature_spec': ..., 'in_label_spec': ...}``.
+  """
+  with open(filename, 'rb') as f:
+    payload = _restricted_load(f.read())
+  if not isinstance(payload, collections.abc.Mapping) or not (
+      'in_feature_spec' in payload and 'in_label_spec' in payload):
+    raise ValueError(
+        '{} is not a reference input_specs.pkl (expected in_feature_spec/'
+        'in_label_spec keys, got {!r}).'.format(
+            filename, sorted(payload) if isinstance(
+                payload, collections.abc.Mapping) else type(payload)))
+  return (_to_spec_struct(payload['in_feature_spec']),
+          _to_spec_struct(payload['in_label_spec']))
+
+
+def load_global_step_from_pkl(filename: str) -> int:
+  """Reads a reference ``global_step.pkl`` (ref tensorspec_utils.py:1721)."""
+  with open(filename, 'rb') as f:
+    payload = _restricted_load(f.read())
+  return int(payload['global_step'])
+
+
+def convert(assets_filepath: str) -> str:
+  """Converts a reference pickle asset dir to ``t2r_assets.pbtxt``.
+
+  Same contract as the reference ``convert()``
+  (convert_pkl_assets_to_proto_assets.py:40): ``input_specs.pkl`` is
+  required, ``global_step.pkl`` optional, and the output lands next to
+  them. Returns the written pbtxt path.
+  """
+  input_spec_filepath = os.path.join(assets_filepath, 'input_specs.pkl')
+  if not os.path.exists(input_spec_filepath):
+    raise ValueError('No file exists for {}.'.format(input_spec_filepath))
+  feature_spec, label_spec = load_input_spec_from_pkl(input_spec_filepath)
+
+  global_step = None
+  global_step_filepath = os.path.join(assets_filepath, 'global_step.pkl')
+  if os.path.exists(global_step_filepath):
+    global_step = load_global_step_from_pkl(global_step_filepath)
+
+  out_path = os.path.join(assets_filepath, T2R_ASSETS_FILENAME)
+  write_t2r_assets_to_file(feature_spec, label_spec, global_step, out_path)
+  return out_path
+
+
+def main(argv=None) -> None:
+  import argparse
+  parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+  parser.add_argument('--assets_filepath', required=True,
+                      help='Exported savedmodel assets directory holding '
+                           'input_specs.pkl (+ optional global_step.pkl).')
+  args = parser.parse_args(argv)
+  print(convert(args.assets_filepath))
+
+
+if __name__ == '__main__':
+  main()

@@ -181,3 +181,178 @@ class TestDqlGraspingHelpers:
     assert out.shape == (6, 4, 4, 8)
     np.testing.assert_allclose(out[0, 0, 0], 1.0 + context[0])
     np.testing.assert_allclose(out[5, 2, 1], 1.0 + context[5])
+
+
+class TestConvertPklAssets:
+  """Migration of reference pickle assets (ref convert_pkl_assets_to_proto_assets.py:40).
+
+  The fixtures below are pickled through stand-in modules registered under
+  the reference's import paths, so the byte streams carry the exact GLOBAL
+  opcodes (`tensor2robot.utils.tensorspec_utils.ExtendedTensorSpec`, TF
+  TensorShape/Dimension/as_dtype) a real reference-written input_specs.pkl
+  carries — without importing the reference.
+  """
+
+  def _reference_pickle(self, payload):
+    import pickle
+    import sys
+    import types
+
+    class _FakeShape:
+      def __init__(self, dims):
+        self._dims = list(dims)
+
+      def __reduce__(self):
+        return (_shape_cls, ([_Dim(d) for d in self._dims],))
+
+    class _Dim:
+      def __init__(self, v):
+        self._v = v
+
+      def __reduce__(self):
+        return (_dim_cls, (self._v,))
+
+    class _FakeDType:
+      def __init__(self, name):
+        self._name = name
+
+      def __reduce__(self):
+        return (_as_dtype_fn, (self._name,))
+
+    class _FakeExtendedSpec:
+      def __init__(self, shape, dtype, name=None, is_optional=None,
+                   is_sequence=False, is_extracted=False, data_format=None,
+                   dataset_key=None, varlen_default_value=None):
+        self.args = (_FakeShape(shape), _FakeDType(dtype), name, is_optional,
+                     is_sequence, is_extracted, data_format, dataset_key,
+                     varlen_default_value)
+
+      def __reduce__(self):
+        return (_ext_cls, self.args)
+
+    shape_mod = types.ModuleType('tensorflow.python.framework.tensor_shape')
+    _shape_cls = type('TensorShape', (), {})
+    _dim_cls = type('Dimension', (), {})
+    shape_mod.TensorShape = _shape_cls
+    shape_mod.Dimension = _dim_cls
+    _shape_cls.__module__ = _dim_cls.__module__ = shape_mod.__name__
+
+    dtype_mod = types.ModuleType('tensorflow.python.framework.dtypes')
+    def _as_dtype_fn(name):
+      return name
+    _as_dtype_fn.__name__ = _as_dtype_fn.__qualname__ = 'as_dtype'
+    _as_dtype_fn.__module__ = dtype_mod.__name__
+    dtype_mod.as_dtype = _as_dtype_fn
+
+    t2r_mod = types.ModuleType('tensor2robot.utils.tensorspec_utils')
+    _ext_cls = type('ExtendedTensorSpec', (), {})
+    _ext_cls.__module__ = t2r_mod.__name__
+    t2r_mod.ExtendedTensorSpec = _ext_cls
+    import collections as _collections
+
+    class _TSS(_collections.OrderedDict):
+      pass
+    _TSS.__name__ = _TSS.__qualname__ = 'TensorSpecStruct'
+    _TSS.__module__ = t2r_mod.__name__
+    t2r_mod.TensorSpecStruct = _TSS
+
+    t2r_pkg = types.ModuleType('tensor2robot')
+    t2r_utils_pkg = types.ModuleType('tensor2robot.utils')
+    t2r_pkg.utils = t2r_utils_pkg
+    t2r_utils_pkg.tensorspec_utils = t2r_mod
+
+    tf_pkg = types.ModuleType('tensorflow')
+    tf_python = types.ModuleType('tensorflow.python')
+    tf_framework = types.ModuleType('tensorflow.python.framework')
+    tf_pkg.python = tf_python
+    tf_python.framework = tf_framework
+    tf_framework.tensor_shape = shape_mod
+    tf_framework.dtypes = dtype_mod
+
+    mods = {m.__name__: m for m in (shape_mod, dtype_mod, t2r_mod,
+                                    t2r_pkg, t2r_utils_pkg,
+                                    tf_pkg, tf_python, tf_framework)}
+    saved = {name: sys.modules.get(name) for name in mods}
+    sys.modules.update(mods)
+    try:
+      data = pickle.dumps(payload(_FakeExtendedSpec, _TSS), protocol=2)
+    finally:
+      for name, mod in saved.items():
+        if mod is None:
+          sys.modules.pop(name, None)
+        else:
+          sys.modules[name] = mod
+    return data
+
+  def _write_reference_assets(self, tmp_path, with_step=True):
+    def payload(spec, struct):
+      feature = struct()
+      feature['state/image'] = spec([512, 640, 3], 'uint8', name='image',
+                                    data_format='jpeg', dataset_key='d0')
+      feature['state/pose'] = spec([7], 'float32', name='pose',
+                                   is_optional=True)
+      label = struct()
+      label['reward'] = spec([1], 'float32', name='reward')
+      return {'in_feature_spec': feature, 'in_label_spec': label}
+
+    (tmp_path / 'input_specs.pkl').write_bytes(self._reference_pickle(payload))
+    if with_step:
+      step = self._reference_pickle(lambda spec, struct: {'global_step': 1234})
+      (tmp_path / 'global_step.pkl').write_bytes(step)
+
+  def test_convert_reference_pickle_dir(self, tmp_path):
+    from tensor2robot_tpu.specs import assets
+    from tensor2robot_tpu.utils import convert_pkl_assets
+
+    self._write_reference_assets(tmp_path)
+    out = convert_pkl_assets.convert(str(tmp_path))
+    assert out.endswith(assets.T2R_ASSETS_FILENAME)
+
+    feature, label, step = assets.load_t2r_assets_from_file(out)
+    assert step == 1234
+    img = feature['state/image']
+    assert img.shape == (512, 640, 3)
+    assert img.dtype == np.uint8
+    assert img.data_format == 'jpeg'
+    assert img.dataset_key == 'd0'
+    assert feature['state/pose'].is_optional
+    assert label['reward'].shape == (1,)
+    assert label['reward'].dtype == np.float32
+
+  def test_convert_without_global_step(self, tmp_path):
+    from tensor2robot_tpu.specs import assets
+    from tensor2robot_tpu.utils import convert_pkl_assets
+
+    self._write_reference_assets(tmp_path, with_step=False)
+    out = convert_pkl_assets.convert(str(tmp_path))
+    _, _, step = assets.load_t2r_assets_from_file(out)
+    assert step is None
+
+  def test_missing_input_specs_raises(self, tmp_path):
+    from tensor2robot_tpu.utils import convert_pkl_assets
+
+    with pytest.raises(ValueError, match='input_specs.pkl'):
+      convert_pkl_assets.convert(str(tmp_path))
+
+  def test_malicious_global_rejected(self, tmp_path):
+    import pickle
+
+    from tensor2robot_tpu.utils import convert_pkl_assets
+
+    evil = pickle.dumps({'in_feature_spec': print, 'in_label_spec': {}})
+    (tmp_path / 'input_specs.pkl').write_bytes(evil)
+    with pytest.raises(pickle.UnpicklingError, match='Refusing'):
+      convert_pkl_assets.convert(str(tmp_path))
+
+  def test_real_tf_shapes_unpickle(self, tmp_path):
+    """A stream pickled with the REAL tf TensorShape/DType resolves too."""
+    tf = pytest.importorskip('tensorflow')
+    import pickle
+
+    from tensor2robot_tpu.utils import convert_pkl_assets
+
+    data = pickle.dumps(
+        {'sh': tf.TensorShape([4, None]), 'dt': tf.bfloat16}, protocol=2)
+    out = convert_pkl_assets._restricted_load(data)
+    assert out['sh'] == (4, None)
+    assert out['dt'] == 'bfloat16'
