@@ -62,18 +62,25 @@ def scaled_dot_attention(q, k, v, causal: bool) -> jnp.ndarray:
                     ).astype(q.dtype)
 
 
+def resolve_attention_mode(mode: str, seq_length: int) -> str:
+  """'auto' -> 'flash'/'xla' by backend and length; other modes pass through.
+
+  Lengths with poor block divisibility fall back to dense rather than
+  running the kernel with tiny blocks (the kernel itself steps its blocks
+  down to dividing sizes, so explicit 'flash' always works — 'auto' just
+  avoids the slow small-block regime).
+  """
+  if mode != 'auto':
+    return mode
+  on_tpu = jax.default_backend() == 'tpu'
+  return 'flash' if (on_tpu and seq_length >= _FLASH_MIN_LENGTH
+                     and seq_length % 128 == 0) else 'xla'
+
+
 def run_attention(q, k, v, *, mode: str, causal: bool,
                   mesh=None, seq_axis: str = 'data') -> jnp.ndarray:
   """Dispatches [B, L, H, D] self-attention to the selected backend."""
-  l = q.shape[1]
-  if mode == 'auto':
-    on_tpu = jax.default_backend() == 'tpu'
-    # Lengths with poor block divisibility fall back to dense rather
-    # than running the kernel with tiny blocks (the kernel itself steps
-    # its blocks down to dividing sizes, so explicit 'flash' always
-    # works — 'auto' just avoids the slow small-block regime).
-    mode = 'flash' if (on_tpu and l >= _FLASH_MIN_LENGTH
-                       and l % 128 == 0) else 'xla'
+  mode = resolve_attention_mode(mode, q.shape[1])
   if mode == 'xla':
     return scaled_dot_attention(q, k, v, causal)
   if mode == 'flash':
@@ -86,30 +93,104 @@ def run_attention(q, k, v, *, mode: str, causal: bool,
   raise ValueError('Unknown attention mode: {!r}'.format(mode))
 
 
+def _constrain(x, mesh, spec):
+  """with_sharding_constraint when a mesh is live; no-op otherwise."""
+  if mesh is None:
+    return x
+  from jax.sharding import NamedSharding
+  return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 class MultiHeadAttention(nn.Module):
-  """Self-attention with pluggable backend (see module docstring)."""
+  """Self-attention with pluggable backend (see module docstring).
+
+  ``tp_axis``: Megatron-style tensor parallelism. The qkv projection is
+  laid out HEAD-MAJOR (columns grouped [H, 3, Dh]) so sharding its output
+  dim over ``tp_axis`` (parallel/sharding.py TP_RULES_TRANSFORMER) splits
+  whole heads per device; attention then computes only local heads, and
+  the out projection's input-dim sharding leaves a partial sum that XLA
+  closes with one psum over the axis. With ``attention_mode='flash'`` the
+  Pallas kernel is wrapped in a shard_map over ``tp_axis`` — attention is
+  head-independent, so each device runs the kernel on its resident heads
+  (a pallas_call is opaque to GSPMD and would otherwise be all-gathered).
+  """
 
   num_heads: int
   head_dim: int
   attention_mode: str = 'auto'
   causal: bool = True
-  mesh: Optional[object] = None  # jax.sharding.Mesh for 'ring'
+  mesh: Optional[object] = None  # jax.sharding.Mesh for 'ring'/tp
   seq_axis: str = 'data'
+  tp_axis: Optional[str] = None
   dtype: jnp.dtype = jnp.float32
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    from jax.sharding import PartitionSpec as P
+
     b, l, _ = x.shape
     features = self.num_heads * self.head_dim
+    if self.tp_axis and self.mesh is not None:
+      tp_size = int(self.mesh.shape.get(self.tp_axis, 1))
+      if self.num_heads % tp_size:
+        # Catch at trace time: the param rule would otherwise shard the
+        # flat qkv column dim mid-head (parallel/sharding.py matches on
+        # divisibility of H*3*Dh, which it cannot decompose into heads).
+        raise ValueError(
+            'tensor parallelism needs num_heads ({}) divisible by the '
+            '{!r} axis size ({}).'.format(self.num_heads, self.tp_axis,
+                                          tp_size))
+    # Head-major qkv columns: [d, H*3*Dh] (NOT q|k|v-major) — see class
+    # docstring; single-chip numerics only permute init columns. NOTE:
+    # checkpoints saved before round 4's head-major change load
+    # shape-compatibly but are scrambled — re-train (none shipped).
     qkv = nn.Dense(3 * features, dtype=self.dtype, name='qkv')(x)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, l, self.num_heads, self.head_dim)
-    k = k.reshape(b, l, self.num_heads, self.head_dim)
-    v = v.reshape(b, l, self.num_heads, self.head_dim)
-    out = run_attention(q, k, v, mode=self.attention_mode, causal=self.causal,
-                        mesh=self.mesh, seq_axis=self.seq_axis)
+    qkv = qkv.reshape(b, l, self.num_heads, 3, self.head_dim)
+    if self.tp_axis:
+      qkv = _constrain(qkv, self.mesh, P(None, None, self.tp_axis, None, None))
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    # Resolve 'auto' BEFORE the tp/flash routing below — otherwise
+    # run_attention would resolve it internally and the opaque
+    # pallas_call would be all-gathered over the model axis.
+    mode = resolve_attention_mode(self.attention_mode, l)
+    if self.tp_axis and mode == 'flash':
+      out = _flash_sharded_heads(q, k, v, causal=self.causal, mesh=self.mesh,
+                                 tp_axis=self.tp_axis)
+    else:
+      out = run_attention(q, k, v, mode=mode, causal=self.causal,
+                          mesh=self.mesh, seq_axis=self.seq_axis)
+    if self.tp_axis:
+      out = _constrain(out, self.mesh, P(None, None, self.tp_axis, None))
     out = out.reshape(b, l, features)
-    return nn.Dense(x.shape[-1], dtype=self.dtype, name='out')(out)
+    out = nn.Dense(x.shape[-1], dtype=self.dtype, name='out')(out)
+    if self.tp_axis:
+      out = _constrain(out, self.mesh, P(None, None, None))
+    return out
+
+
+def _flash_sharded_heads(q, k, v, *, causal: bool, mesh, tp_axis: str):
+  """Flash attention with heads resident per tp shard via shard_map.
+
+  The batch dim is also sharded over the mesh's data axis when the batch
+  divides it — without that, a data x model mesh would all-gather q/k/v
+  over 'data' and run the kernel on the full global batch per device.
+  """
+  from functools import partial
+
+  from jax.experimental.shard_map import shard_map
+  from jax.sharding import PartitionSpec as P
+
+  from tensor2robot_tpu.parallel.mesh import DATA_AXIS
+
+  data_size = int(mesh.shape.get(DATA_AXIS, 1))
+  batch_axis = (DATA_AXIS
+                if data_size > 1 and q.shape[0] % data_size == 0 else None)
+  spec = P(batch_axis, None, tp_axis, None)
+  fn = shard_map(
+      partial(flash_lib.flash_attention, causal=causal),
+      mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+      check_rep=False)
+  return fn(q, k, v)
 
 
 class TransformerBlock(nn.Module):
@@ -122,25 +203,34 @@ class TransformerBlock(nn.Module):
   causal: bool = True
   mesh: Optional[object] = None
   seq_axis: str = 'data'
+  tp_axis: Optional[str] = None
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    from jax.sharding import PartitionSpec as P
+
     # LayerNorm in f32: bf16 variance over long sequences loses precision.
     h = nn.LayerNorm(dtype=jnp.float32, name='ln_attn')(x).astype(self.dtype)
     h = MultiHeadAttention(
         num_heads=self.num_heads, head_dim=self.head_dim,
         attention_mode=self.attention_mode, causal=self.causal,
-        mesh=self.mesh, seq_axis=self.seq_axis, dtype=self.dtype,
-        name='attn')(h)
+        mesh=self.mesh, seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+        dtype=self.dtype, name='attn')(h)
     if self.dropout_rate:
       h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
     x = x + h
     h = nn.LayerNorm(dtype=jnp.float32, name='ln_mlp')(x).astype(self.dtype)
     h = nn.Dense(self.mlp_dim, dtype=self.dtype, name='mlp_in')(h)
+    if self.tp_axis:
+      # Hidden activations shard over tp ([B, L, mlp/|model| per device]);
+      # mlp_out's input-dim sharding then yields the closing psum.
+      h = _constrain(h, self.mesh, P(None, None, self.tp_axis))
     h = nn.gelu(h)
     h = nn.Dense(x.shape[-1], dtype=self.dtype, name='mlp_out')(h)
+    if self.tp_axis:
+      h = _constrain(h, self.mesh, P(None, None, None))
     if self.dropout_rate:
       h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
     return x + h
@@ -219,6 +309,7 @@ class CausalTransformer(nn.Module):
   attention_mode: str = 'auto'
   mesh: Optional[object] = None
   seq_axis: str = 'data'
+  tp_axis: Optional[str] = None
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
 
@@ -236,6 +327,6 @@ class CausalTransformer(nn.Module):
           num_heads=self.num_heads, head_dim=self.head_dim,
           mlp_dim=self.mlp_dim, attention_mode=self.attention_mode,
           causal=True, mesh=self.mesh, seq_axis=self.seq_axis,
-          dropout_rate=self.dropout_rate, dtype=self.dtype,
-          name='block{}'.format(i))(x, train=train)
+          tp_axis=self.tp_axis, dropout_rate=self.dropout_rate,
+          dtype=self.dtype, name='block{}'.format(i))(x, train=train)
     return nn.LayerNorm(dtype=jnp.float32, name='ln_final')(x)

@@ -9,13 +9,14 @@ psum is derived by XLA from these placements.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import re
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensor2robot_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+from tensor2robot_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -46,14 +47,80 @@ def fsdp_param_spec(param, mesh: Mesh,
   return P()
 
 
+# Megatron-style tensor-parallel rules for layers/transformer.py modules:
+# qkv columns are head-major (kernel [d, H*3*Dh]) so sharding the output
+# dim over 'model' splits whole heads; the out/mlp_out kernels shard their
+# INPUT dim, making each device's contribution a partial sum that XLA
+# closes with a psum over 'model' (the Megatron f/g collectives, derived
+# by GSPMD from these placements instead of hand-written all-reduces).
+TP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
+    (r'.*/attn/qkv/kernel$', P(None, MODEL_AXIS)),
+    (r'.*/attn/qkv/bias$', P(MODEL_AXIS)),
+    (r'.*/attn/out/kernel$', P(MODEL_AXIS, None)),
+    (r'.*/mlp_in/kernel$', P(None, MODEL_AXIS)),
+    (r'.*/mlp_in/bias$', P(MODEL_AXIS)),
+    (r'.*/mlp_out/kernel$', P(MODEL_AXIS, None)),
+)
+
+
+def _path_str(path) -> str:
+  parts = []
+  for entry in path:
+    if hasattr(entry, 'key'):
+      parts.append(str(entry.key))
+    elif hasattr(entry, 'idx'):
+      parts.append(str(entry.idx))
+    elif hasattr(entry, 'name'):
+      parts.append(str(entry.name))
+    else:
+      parts.append(str(entry))
+  return '/'.join(parts)
+
+
+def tp_param_spec(path_str: str, param, mesh: Mesh,
+                  rules: Sequence[Tuple[str, P]]) -> Optional[P]:
+  """First matching tensor-parallel rule whose axes divide the param."""
+  size = int(mesh.shape.get(MODEL_AXIS, 1))
+  if size <= 1:
+    return None
+  shape = getattr(param, 'shape', ())
+  for pattern, spec in rules:
+    if re.match(pattern, path_str):
+      if len(spec) > len(shape):
+        return None
+      for dim, axis in enumerate(spec):
+        if axis is not None and shape[dim] % size:
+          return None  # indivisible: replicate rather than mis-shard
+      return spec
+  return None
+
+
 def train_state_sharding(state, mesh: Mesh,
-                         use_fsdp: bool = False):
-  """Sharding pytree for a TrainState: replicated, or FSDP for params/opt."""
-  def _spec(leaf):
-    if use_fsdp and hasattr(leaf, 'shape') and hasattr(leaf, 'size'):
-      return NamedSharding(mesh, fsdp_param_spec(leaf, mesh))
+                         use_fsdp: bool = False,
+                         tp_rules: Optional[Sequence[Tuple[str, P]]] = None):
+  """Sharding pytree for a TrainState: replicated, FSDP, and/or TP.
+
+  ``tp_rules``: (path regex, PartitionSpec) pairs (e.g.
+  TP_RULES_TRANSFORMER) matched against '/'-joined tree paths; matching
+  params take the TP spec, everything else falls back to FSDP (if
+  enabled) then replication. A param is never sharded on both — TP params
+  are already split |model|-ways, and stacking 'fsdp' on their other dim
+  would fragment the matmul tiles XLA feeds the MXU.
+  """
+  leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+
+  def _spec(path, leaf):
+    if hasattr(leaf, 'shape') and hasattr(leaf, 'size'):
+      if tp_rules:
+        tp = tp_param_spec(_path_str(path), leaf, mesh, tp_rules)
+        if tp is not None:
+          return NamedSharding(mesh, tp)
+      if use_fsdp:
+        return NamedSharding(mesh, fsdp_param_spec(leaf, mesh))
     return NamedSharding(mesh, P())
-  return jax.tree.map(_spec, state)
+
+  return jax.tree_util.tree_unflatten(
+      treedef, [_spec(path, leaf) for path, leaf in leaves])
 
 
 def shard_batch(batch, mesh: Mesh):

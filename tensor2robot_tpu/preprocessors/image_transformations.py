@@ -48,11 +48,15 @@ def crop_images(images: List[jnp.ndarray], offsets,
   return [jax.vmap(_crop_one)(img, offsets) for img in images]
 
 
-def random_crop_images(key: jax.Array, images: List[jnp.ndarray],
-                       target_shape: Tuple[int, int]) -> List[jnp.ndarray]:
-  """Random crop, identical offsets across views of one example (ref :31)."""
-  _check_shapes(images)
-  batch, height, width = images[0].shape[0], images[0].shape[1], images[0].shape[2]
+def random_crop_offsets(key: jax.Array, batch: int,
+                        image_shape: Tuple[int, int],
+                        target_shape: Tuple[int, int]) -> jnp.ndarray:
+  """Per-example uniform (y, x) crop offsets as an int [batch, 2] array.
+
+  Factored out of :func:`random_crop_images` so fused crop kernels
+  (``preprocessors/pallas_crop.py``) sample identically to the XLA path.
+  """
+  height, width = image_shape
   th, tw = target_shape
   if th > height or tw > width:
     raise ValueError('Crop {} exceeds image size {}.'.format(
@@ -60,7 +64,15 @@ def random_crop_images(key: jax.Array, images: List[jnp.ndarray],
   ky, kx = jax.random.split(key)
   ys = jax.random.randint(ky, (batch,), 0, height - th + 1)
   xs = jax.random.randint(kx, (batch,), 0, width - tw + 1)
-  offsets = jnp.stack([ys, xs], axis=-1)
+  return jnp.stack([ys, xs], axis=-1)
+
+
+def random_crop_images(key: jax.Array, images: List[jnp.ndarray],
+                       target_shape: Tuple[int, int]) -> List[jnp.ndarray]:
+  """Random crop, identical offsets across views of one example (ref :31)."""
+  _check_shapes(images)
+  batch, height, width = images[0].shape[0], images[0].shape[1], images[0].shape[2]
+  offsets = random_crop_offsets(key, batch, (height, width), target_shape)
   return crop_images(images, offsets, target_shape)
 
 

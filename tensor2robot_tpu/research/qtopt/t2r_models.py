@@ -41,6 +41,7 @@ from tensor2robot_tpu.models import abstract_model
 from tensor2robot_tpu.models.critic_model import CriticModel
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.preprocessors import image_transformations
+from tensor2robot_tpu.preprocessors import pallas_crop
 from tensor2robot_tpu.preprocessors.spec_transformation_preprocessor import (
     SpecTransformationPreprocessor,
 )
@@ -157,12 +158,26 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
   """
 
   def __init__(self, *args, distortion_kwargs: Optional[dict] = None,
-               **kwargs):
+               use_fused_crop: Optional[bool] = None, **kwargs):
     """``distortion_kwargs`` forward to
     apply_photometric_image_distortions (e.g. {'random_brightness': True,
-    'random_noise_level': 0.05}); default empty == reference defaults."""
+    'random_noise_level': 0.05}); default empty == reference defaults.
+
+    ``use_fused_crop``: route the TRAIN crop+convert through the fused
+    Pallas pass (``preprocessors/pallas_crop.py``) instead of the vmapped
+    dynamic-slice + separate float convert. Numerics match the XLA path
+    to 1 ulp with identical crop-offset sampling — but measured in the
+    FULL batch-512 train step the kernel is ~3% SLOWER (183.6/180.3 ms
+    f32/bf16-out vs 178.4 ms; docs/performance.md "Measured dead ends")
+    despite being 7.5x faster in isolation: XLA fuses the convert into
+    neighboring ops and the opaque pallas_call re-introduces a fusion
+    barrier + conv1-input relayout. Default (``None``) therefore resolves
+    to OFF; the flag stays for pipelines where the crop is NOT adjacent
+    to a large fusible program.
+    """
     super().__init__(*args, **kwargs)
     self._distortion_kwargs = dict(distortion_kwargs or {})
+    self._use_fused_crop = use_fused_crop
 
   def update_spec_transform(self, key: str, spec: TensorSpec,
                             mode: str) -> TensorSpec:
@@ -178,9 +193,17 @@ class DefaultGrasping44ImagePreprocessor(SpecTransformationPreprocessor):
       if rng is None:
         raise ValueError('TRAIN-mode preprocessing requires an rng key.')
       crop_rng, distort_rng = jax.random.split(jnp.asarray(rng))
-      image = image_transformations.random_crop_images(
-          crop_rng, [image], TARGET_SHAPE)[0]
-      image = jnp.asarray(image, jnp.float32) / 255.0
+      # Default OFF: measured slower inside the full step (see __init__).
+      use_fused = bool(self._use_fused_crop) and (
+          image.dtype == jnp.uint8 and pallas_crop.supported(image.shape))
+      if use_fused:
+        offsets = image_transformations.random_crop_offsets(
+            crop_rng, image.shape[0], image.shape[1:3], TARGET_SHAPE)
+        image = pallas_crop.fused_crop_convert(image, offsets, TARGET_SHAPE)
+      else:
+        image = image_transformations.random_crop_images(
+            crop_rng, [image], TARGET_SHAPE)[0]
+        image = jnp.asarray(image, jnp.float32) / 255.0
       if self._distortion_kwargs:
         image = image_transformations.apply_photometric_image_distortions(
             distort_rng, [image], **self._distortion_kwargs)[0]

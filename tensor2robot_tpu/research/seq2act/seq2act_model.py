@@ -132,6 +132,7 @@ class RT1StyleNet(nn.Module):
   tokenizer_widths: tuple
   attention_mode: str = 'auto'
   mesh: Optional[object] = None
+  tp_axis: Optional[str] = None
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
   use_state_input: bool = False
@@ -180,8 +181,8 @@ class RT1StyleNet(nn.Module):
         head_dim=self.head_dim, mlp_dim=self.mlp_dim,
         max_length=self.max_episode_length * k,
         attention_mode=self.attention_mode, mesh=self.mesh,
-        dropout_rate=self.dropout_rate, dtype=self.dtype,
-        name='transformer')(tokens, train=train)
+        tp_axis=self.tp_axis, dropout_rate=self.dropout_rate,
+        dtype=self.dtype, name='transformer')(tokens, train=train)
     # Last token of each frame: under the token-causal mask it has seen the
     # whole frame plus all history — the natural readout position.
     frame_out = encoded.reshape(b, t, k, -1)[:, :, -1, :]
@@ -212,6 +213,7 @@ class Seq2ActBCModel(AbstractT2RModel):
                action_max: float = 1.0,
                attention_mode: str = 'auto',
                mesh: Optional[object] = None,
+               tp_axis: Optional[str] = None,
                max_episode_length: Optional[int] = None,
                dropout_rate: float = 0.0,
                use_state_input: bool = False,
@@ -244,6 +246,7 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._action_max = action_max
     self._attention_mode = attention_mode
     self._mesh = mesh
+    self._tp_axis = tp_axis
     self._max_episode_length = max_episode_length or episode_length
     self._dropout_rate = dropout_rate
     self._use_state_input = use_state_input
@@ -289,6 +292,7 @@ class Seq2ActBCModel(AbstractT2RModel):
         tokenizer_widths=self._tokenizer_widths,
         attention_mode=self._attention_mode,
         mesh=self._mesh,
+        tp_axis=self._tp_axis,
         dropout_rate=self._dropout_rate,
         dtype=self.compute_dtype,
         use_state_input=self._use_state_input,

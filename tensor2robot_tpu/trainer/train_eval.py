@@ -72,6 +72,7 @@ class Trainer:
                model_dir: str,
                mesh: Optional[Mesh] = None,
                use_fsdp: bool = False,
+               tp_rules=None,
                seed: int = 0,
                keep_checkpoint_max: int = 5,
                save_checkpoints_steps: int = 500,
@@ -90,6 +91,11 @@ class Trainer:
     self.model_dir = model_dir
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
     self.use_fsdp = use_fsdp
+    # (path-regex, PartitionSpec) pairs for tensor-parallel params over the
+    # mesh's 'model' axis (parallel/sharding.py TP_RULES_TRANSFORMER);
+    # None = no TP. The model must also be built with the matching
+    # tp_axis so activations carry the same placement.
+    self.tp_rules = tp_rules
     self.seed = seed
     self.log_every_n_steps = log_every_n_steps
     self.save_checkpoints_steps = save_checkpoints_steps
@@ -192,7 +198,8 @@ class Trainer:
     abstract_state = jax.eval_shape(
         lambda: self.model.create_train_state(rng, features, labels))
     self._state_sharding = sharding_lib.train_state_sharding(
-        abstract_state, self.mesh, use_fsdp=self.use_fsdp)
+        abstract_state, self.mesh, use_fsdp=self.use_fsdp,
+        tp_rules=self.tp_rules)
     # Re-read disk: a concurrent trainer may have written checkpoints
     # since this manager was constructed (continuous-eval topology).
     self.checkpoint_manager.reload()

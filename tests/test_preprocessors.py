@@ -442,3 +442,76 @@ class TestDeviceDecodePreprocessor:
         mesh=parallel.create_mesh({'data': 1}, devices=jax.devices()[:1]),
         async_checkpoints=False)
     assert int(jax.device_get(results['state'].step)) == 2
+
+
+class TestFusedCropConvert:
+  """preprocessors/pallas_crop.py vs the XLA dynamic-slice path.
+
+  Runs the kernel in interpret mode on CPU; the on-chip parity record is
+  docs/performance.md (1-ulp vs the XLA path — the in-kernel divide
+  compiles to a reciprocal multiply).
+  """
+
+  def _ref(self, imgs, offs, target):
+    cropped = image_transformations.crop_images(
+        [jnp.asarray(imgs)], jnp.asarray(offs), target)[0]
+    return np.asarray(cropped, np.float32) / 255.0
+
+  def test_parity_random_offsets(self):
+    from tensor2robot_tpu.preprocessors import pallas_crop
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (4, 64, 128, 3), dtype=np.uint8)
+    offs = np.stack([rng.randint(0, 64 - 40 + 1, 4),
+                     rng.randint(0, 128 - 56 + 1, 4)], -1).astype(np.int32)
+    got = np.asarray(pallas_crop.fused_crop_convert(
+        jnp.asarray(imgs), offs, (40, 56), interpret=True))
+    np.testing.assert_allclose(got, self._ref(imgs, offs, (40, 56)),
+                               atol=1e-7)
+
+  def test_extreme_offsets_match_dynamic_slice_clamp(self):
+    from tensor2robot_tpu.preprocessors import pallas_crop
+
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (3, 16, 128, 1), dtype=np.uint8)
+    # Zero, max-valid, and out-of-range (must clamp like dynamic_slice).
+    offs = np.array([[0, 0], [8, 64], [100, 1000]], np.int32)
+    got = np.asarray(pallas_crop.fused_crop_convert(
+        jnp.asarray(imgs), offs, (8, 64), interpret=True))
+    np.testing.assert_allclose(got, self._ref(imgs, offs, (8, 64)),
+                               atol=1e-7)
+
+  def test_unsupported_shapes_raise(self):
+    from tensor2robot_tpu.preprocessors import pallas_crop
+
+    assert not pallas_crop.supported((2, 63, 128, 3))   # H % 8
+    assert not pallas_crop.supported((2, 64, 100, 3))   # W*C % 128
+    with pytest.raises(ValueError, match='Unsupported image shape'):
+      pallas_crop.fused_crop_convert(
+          jnp.zeros((2, 64, 100, 3), jnp.uint8), np.zeros((2, 2), np.int32),
+          (32, 50), interpret=True)
+    with pytest.raises(ValueError, match='uint8'):
+      pallas_crop.fused_crop_convert(
+          jnp.zeros((2, 64, 128, 3), jnp.float32), np.zeros((2, 2), np.int32),
+          (32, 64), interpret=True)
+
+  def test_grasping_preprocessor_fused_matches_xla(self):
+    """Same rng => same offsets => same pixels through the full TRAIN path."""
+    from tensor2robot_tpu.research.qtopt import t2r_models
+
+    rng = np.random.RandomState(2)
+    # Full-size frames so the shape qualifies for the fused path.
+    image = rng.randint(0, 256, (2, 512, 640, 3), dtype=np.uint8)
+    key = jax.random.PRNGKey(7)
+    outs = {}
+    for fused in (False, True):
+      pre = t2r_models.DefaultGrasping44ImagePreprocessor(
+          model_feature_specification_fn=lambda mode: SpecStruct(),
+          model_label_specification_fn=lambda mode: SpecStruct(),
+          use_fused_crop=fused)
+      features = SpecStruct()
+      features['state/image'] = jnp.asarray(image)
+      got, _ = pre._preprocess_fn(features, None, ModeKeys.TRAIN, rng=key)
+      outs[fused] = np.asarray(got['state/image'])
+    assert outs[True].shape == (2, 472, 472, 3)
+    np.testing.assert_allclose(outs[True], outs[False], atol=1e-7)
