@@ -1,0 +1,131 @@
+"""Mixture-of-Experts MLP with expert parallelism over the mesh.
+
+The reference has no MoE; this is part of the build-side mandate that
+distributed training be first-class (SURVEY.md §5 build goals), filling
+the 'ep' slot next to dp/fsdp/tp/sp. The design is the GShard/Switch
+dispatch in its TPU-native form:
+
+* **Static shapes everywhere.** Routing uses one-hot dispatch/combine
+  einsums against a fixed per-expert capacity — no gather/scatter with
+  data-dependent shapes, which XLA cannot tile. Tokens over capacity are
+  dropped (their residual branch contributes zero), the standard
+  Switch-style overflow semantics.
+* **Experts as stacked params.** All experts live in single
+  [E, d, h]/[E, h, d] tensors computed with einsums over the expert dim;
+  under expert parallelism those params and the [E, C, d] dispatched
+  activations carry a ``P('expert', ...)`` sharding
+  (EP_RULES_MOE in parallel/sharding.py + the in-layer constraints) and
+  GSPMD lowers the dispatch/combine einsums to all-to-alls over the
+  'expert' axis — the MoE communication pattern, derived not hand-coded.
+* **Router in f32** (logits, softmax, and the load-balancing auxiliary
+  loss) regardless of the activation dtype: top-k ties and the aux-loss
+  gradients are precision-sensitive at bf16.
+
+The auxiliary load-balancing loss is the Switch formulation
+(mean over experts of fraction_dispatched * mean_router_prob, scaled by
+E); consumers add ``aux_weight * aux_loss`` to their objective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+from tensor2robot_tpu.parallel.sharding import constrain
+
+
+class MoEMlp(nn.Module):
+  """Top-k routed expert MLP: [B, L, d] -> [B, L, d] (+ aux loss).
+
+  ``capacity_factor``: per-expert slots = ceil(k * L * factor / E),
+  rounded up to a multiple of 8 (sublane alignment). With
+  ``capacity_factor >= E / k`` no token can overflow (useful in tests).
+  Returns ``(out, aux_loss)``; aux_loss is the Switch load-balance term.
+  """
+
+  num_experts: int
+  expert_dim: int
+  top_k: int = 2
+  capacity_factor: float = 1.25
+  mesh: Optional[object] = None
+  ep_axis: Optional[str] = None
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    b, l, d = x.shape
+    e, k = self.num_experts, min(self.top_k, self.num_experts)
+    if self.ep_axis and self.mesh is not None:
+      if self.ep_axis not in self.mesh.shape:
+        raise ValueError(
+            'ep_axis {!r} is not an axis of the mesh (axes: {}); build the '
+            'mesh with an expert axis (parallel.create_mesh).'.format(
+                self.ep_axis, tuple(self.mesh.axis_names)))
+      ep_size = int(self.mesh.shape[self.ep_axis])
+      if e % ep_size:
+        raise ValueError(
+            'expert parallelism needs num_experts ({}) divisible by the '
+            '{!r} axis size ({}).'.format(e, self.ep_axis, ep_size))
+    capacity = int(np.ceil(k * l * self.capacity_factor / e))
+    capacity = max(8, -(-capacity // 8) * 8)
+    capacity = min(capacity, l)
+
+    # Router (f32): probs over experts per token.
+    logits = nn.Dense(e, dtype=jnp.float32, name='router')(
+        x.astype(jnp.float32))                              # [B, L, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # Top-k expert choice per token, then per-expert position assignment.
+    _, expert_idx = jax.lax.top_k(probs, k)                 # [B, L, K]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B, L, K, E]
+    # Position of each (token, choice) in its expert's buffer: the
+    # running count of earlier assignments to that expert (k-major so a
+    # token's secondary choice queues behind all primary choices of
+    # earlier tokens at the same expert only via the cumsum order below).
+    flat = onehot.transpose(0, 2, 1, 3).reshape(b, k * l, e)  # [B, KL, E]
+    position = jnp.cumsum(flat, axis=1) - flat              # [B, KL, E]
+    in_capacity = position < capacity
+    flat = flat * in_capacity
+    pos_onehot = flat[..., None] * jax.nn.one_hot(
+        position.astype(jnp.int32), capacity,
+        dtype=jnp.float32)                                  # [B, KL, E, C]
+    dispatch = pos_onehot.reshape(b, k, l, e, capacity).sum(1)  # [B,L,E,C]
+
+    # Gate values for surviving assignments, renormalized over kept k.
+    gate = (dispatch.sum(-1) * probs)                       # [B, L, E]
+    denom = jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+    combine = (gate / denom)[..., None] * dispatch          # [B, L, E, C]
+
+    # Dispatch -> expert MLP -> combine, expert dim sharded over ep_axis.
+    w_in = self.param('w_in', nn.initializers.lecun_normal(),
+                      (e, d, self.expert_dim), jnp.float32)
+    w_out = self.param('w_out', nn.initializers.lecun_normal(),
+                       (e, self.expert_dim, d), jnp.float32)
+    ep = self.ep_axis
+    expert_in = jnp.einsum('blec,bld->ebcd', dispatch.astype(self.dtype),
+                           x.astype(self.dtype))            # [E, B, C, d]
+    from jax.sharding import PartitionSpec as P
+    if ep:
+      expert_in = constrain(expert_in, self.mesh, P(ep, None, None, None))
+    h = jnp.einsum('ebcd,edh->ebch', expert_in,
+                   w_in.astype(self.dtype))
+    h = nn.gelu(h)
+    expert_out = jnp.einsum('ebch,ehd->ebcd', h,
+                            w_out.astype(self.dtype))       # [E, B, C, d]
+    if ep:
+      expert_out = constrain(expert_out, self.mesh, P(ep, None, None, None))
+    out = jnp.einsum('blec,ebcd->bld', combine.astype(self.dtype),
+                     expert_out)
+
+    # Switch load-balance loss: E * sum_e fraction_tokens_e * mean_prob_e
+    # (uses the pre-capacity primary assignments, the standard estimator).
+    primary = jax.nn.one_hot(expert_idx[..., 0], e, dtype=jnp.float32)
+    fraction = primary.reshape(-1, e).mean(0)
+    mean_prob = probs.reshape(-1, e).mean(0)
+    aux_loss = e * jnp.sum(fraction * mean_prob)
+    return out.astype(x.dtype), aux_loss

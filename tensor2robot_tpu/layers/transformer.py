@@ -45,6 +45,8 @@ flash_lib = importlib.import_module(
 ring_lib = importlib.import_module(
     'tensor2robot_tpu.parallel.ring_attention')
 
+from tensor2robot_tpu.parallel.sharding import constrain as _constrain
+
 _FLASH_MIN_LENGTH = 2048
 
 
@@ -91,14 +93,6 @@ def run_attention(q, k, v, *, mode: str, causal: bool,
     return ring_lib.ring_self_attention(q, k, v, mesh, seq_axis=seq_axis,
                                         causal=causal)
   raise ValueError('Unknown attention mode: {!r}'.format(mode))
-
-
-def _constrain(x, mesh, spec):
-  """with_sharding_constraint when a mesh is live; no-op otherwise."""
-  if mesh is None:
-    return x
-  from jax.sharding import NamedSharding
-  return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 class MultiHeadAttention(nn.Module):
@@ -204,11 +198,18 @@ class TransformerBlock(nn.Module):
   mesh: Optional[object] = None
   seq_axis: str = 'data'
   tp_axis: Optional[str] = None
+  moe_experts: int = 0           # > 0: MoE MLP instead of the dense MLP
+  moe_top_k: int = 2
+  ep_axis: Optional[str] = None  # expert-parallel mesh axis for the MoE
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
 
   @nn.compact
-  def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+  def __call__(self, x: jnp.ndarray, train: bool = False):
+    """Returns (x, aux_loss) — aux is the MoE load-balance term (0 when
+    the block uses the dense MLP), threaded explicitly rather than via a
+    mutable flax collection so it reaches the loss through the pure
+    functional path the train step differentiates."""
     from jax.sharding import PartitionSpec as P
 
     # LayerNorm in f32: bf16 variance over long sequences loses precision.
@@ -221,19 +222,28 @@ class TransformerBlock(nn.Module):
     if self.dropout_rate:
       h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
     x = x + h
+    aux = jnp.zeros((), jnp.float32)
     h = nn.LayerNorm(dtype=jnp.float32, name='ln_mlp')(x).astype(self.dtype)
-    h = nn.Dense(self.mlp_dim, dtype=self.dtype, name='mlp_in')(h)
-    if self.tp_axis:
-      # Hidden activations shard over tp ([B, L, mlp/|model| per device]);
-      # mlp_out's input-dim sharding then yields the closing psum.
-      h = _constrain(h, self.mesh, P(None, None, self.tp_axis))
-    h = nn.gelu(h)
-    h = nn.Dense(x.shape[-1], dtype=self.dtype, name='mlp_out')(h)
-    if self.tp_axis:
-      h = _constrain(h, self.mesh, P(None, None, None))
+    if self.moe_experts:
+      from tensor2robot_tpu.layers.moe import MoEMlp
+
+      h, aux = MoEMlp(
+          num_experts=self.moe_experts, expert_dim=self.mlp_dim,
+          top_k=self.moe_top_k, mesh=self.mesh, ep_axis=self.ep_axis,
+          dtype=self.dtype, name='moe')(h)
+    else:
+      h = nn.Dense(self.mlp_dim, dtype=self.dtype, name='mlp_in')(h)
+      if self.tp_axis:
+        # Hidden activations shard over tp ([B, L, mlp/|model| each);
+        # mlp_out's input-dim sharding then yields the closing psum.
+        h = _constrain(h, self.mesh, P(None, None, self.tp_axis))
+      h = nn.gelu(h)
+      h = nn.Dense(x.shape[-1], dtype=self.dtype, name='mlp_out')(h)
+      if self.tp_axis:
+        h = _constrain(h, self.mesh, P(None, None, None))
     if self.dropout_rate:
       h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
-    return x + h
+    return x + h, aux
 
 
 class TokenLearner(nn.Module):
@@ -310,11 +320,16 @@ class CausalTransformer(nn.Module):
   mesh: Optional[object] = None
   seq_axis: str = 'data'
   tp_axis: Optional[str] = None
+  moe_experts: int = 0
+  moe_top_k: int = 2
+  ep_axis: Optional[str] = None
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
 
   @nn.compact
-  def __call__(self, tokens: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+  def __call__(self, tokens: jnp.ndarray, train: bool = False):
+    """Returns (encoded, aux_loss) — summed MoE load-balance loss over
+    blocks, 0.0 for a dense (non-MoE) stack."""
     b, l, d = tokens.shape
     if l > self.max_length:
       raise ValueError('Sequence length {} exceeds max_length {}.'.format(
@@ -322,11 +337,15 @@ class CausalTransformer(nn.Module):
     pos = self.param('pos_embedding', nn.initializers.normal(0.02),
                      (self.max_length, d), jnp.float32)
     x = tokens + pos[None, :l].astype(tokens.dtype)
+    aux_total = jnp.zeros((), jnp.float32)
     for i in range(self.num_layers):
-      x = TransformerBlock(
+      x, aux = TransformerBlock(
           num_heads=self.num_heads, head_dim=self.head_dim,
           mlp_dim=self.mlp_dim, attention_mode=self.attention_mode,
           causal=True, mesh=self.mesh, seq_axis=self.seq_axis,
-          tp_axis=self.tp_axis, dropout_rate=self.dropout_rate,
+          tp_axis=self.tp_axis, moe_experts=self.moe_experts,
+          moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+          dropout_rate=self.dropout_rate,
           dtype=self.dtype, name='block{}'.format(i))(x, train=train)
-    return nn.LayerNorm(dtype=jnp.float32, name='ln_final')(x)
+      aux_total = aux_total + aux
+    return nn.LayerNorm(dtype=jnp.float32, name='ln_final')(x), aux_total

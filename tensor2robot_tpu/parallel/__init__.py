@@ -2,12 +2,15 @@
 
 from tensor2robot_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
     create_hybrid_mesh,
     create_mesh,
 )
 from tensor2robot_tpu.parallel.sharding import (
+    EP_RULES_MOE,
+    TP_RULES_TRANSFORMER,
     batch_sharding,
     fsdp_param_spec,
     global_batch_size_per_host,

@@ -10,6 +10,9 @@ Axis convention (used across the framework):
   * 'data'  — batch (data parallel); gradients psum here.
   * 'fsdp'  — optional parameter sharding axis (zero-style), ICI-local.
   * 'model' — tensor parallelism for layers that opt in.
+  * 'expert' — expert parallelism for MoE layers (layers/moe.py): the
+    stacked expert params and the [E, ...] dispatch activations shard
+    here; GSPMD lowers the dispatch/combine einsums to all-to-alls.
 Sequence parallelism ('sp') reuses the 'data' axis via
 parallel.ring_attention — sequence blocks ride the same ring.
 """
@@ -26,7 +29,8 @@ from jax.sharding import Mesh
 DATA_AXIS = 'data'
 FSDP_AXIS = 'fsdp'
 MODEL_AXIS = 'model'
-DEFAULT_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS)
+EXPERT_AXIS = 'expert'
+DEFAULT_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, EXPERT_AXIS)
 
 
 def create_mesh(axis_sizes: Optional[Dict[str, int]] = None,
@@ -61,7 +65,7 @@ def create_mesh(axis_sizes: Optional[Dict[str, int]] = None,
         'Mesh axes {} require {} devices but {} are available.'.format(
             axis_sizes, total, n))
   # Order axes: data outermost, model innermost (fastest links).
-  names = [a for a in (DATA_AXIS, FSDP_AXIS, MODEL_AXIS) if a in axis_sizes]
+  names = [a for a in DEFAULT_AXES if a in axis_sizes]
   names += [a for a in axis_sizes if a not in names]
   shape = [axis_sizes[a] for a in names]
   try:

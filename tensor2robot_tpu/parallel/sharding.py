@@ -16,7 +16,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensor2robot_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, MODEL_AXIS
+from tensor2robot_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
+                                             FSDP_AXIS, MODEL_AXIS)
+
+
+def constrain(x, mesh: Optional[Mesh], spec: P):
+  """with_sharding_constraint when a mesh is live; identity otherwise.
+
+  Shared by the TP/EP layer paths (layers/transformer.py, layers/moe.py)
+  so activation-placement handling stays in one place.
+  """
+  if mesh is None:
+    return x
+  return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -63,6 +75,14 @@ TP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
 )
 
 
+# Expert-parallel rules for layers/moe.py: the stacked per-expert MLP
+# kernels shard their leading expert dim; the router stays replicated.
+EP_RULES_MOE: Tuple[Tuple[str, P], ...] = (
+    (r'.*/moe/w_in$', P(EXPERT_AXIS, None, None)),
+    (r'.*/moe/w_out$', P(EXPERT_AXIS, None, None)),
+)
+
+
 def _path_str(path) -> str:
   parts = []
   for entry in path:
@@ -79,19 +99,27 @@ def _path_str(path) -> str:
 
 def tp_param_spec(path_str: str, param, mesh: Mesh,
                   rules: Sequence[Tuple[str, P]]) -> Optional[P]:
-  """First matching tensor-parallel rule whose axes divide the param."""
-  size = int(mesh.shape.get(MODEL_AXIS, 1))
-  if size <= 1:
-    return None
+  """First matching model-parallel rule whose axes divide the param.
+
+  Works for any rule set naming mesh axes (TP_RULES_TRANSFORMER over
+  'model', EP_RULES_MOE over 'expert', or user rules); a rule declines
+  (param stays on the fallback path) when its axes are absent/size-1 in
+  the mesh or don't divide the param's dims.
+  """
   shape = getattr(param, 'shape', ())
   for pattern, spec in rules:
     if re.match(pattern, path_str):
       if len(spec) > len(shape):
         return None
+      sharded_any = False
       for dim, axis in enumerate(spec):
-        if axis is not None and shape[dim] % size:
+        if axis is None:
+          continue
+        size = int(mesh.shape.get(axis, 1))
+        if size <= 1 or shape[dim] % size:
           return None  # indivisible: replicate rather than mis-shard
-      return spec
+        sharded_any = True
+      return spec if sharded_any else None
   return None
 
 

@@ -133,6 +133,9 @@ class RT1StyleNet(nn.Module):
   attention_mode: str = 'auto'
   mesh: Optional[object] = None
   tp_axis: Optional[str] = None
+  moe_experts: int = 0
+  moe_top_k: int = 2
+  ep_axis: Optional[str] = None
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
   use_state_input: bool = False
@@ -176,19 +179,24 @@ class RT1StyleNet(nn.Module):
       tokens = jnp.concatenate([tokens, state_token], axis=2)
       k += 1
     tokens = tokens.reshape(b, t * k, self.embed_dim)
-    encoded = transformer_lib.CausalTransformer(
+    encoded, moe_aux = transformer_lib.CausalTransformer(
         num_layers=self.num_layers, num_heads=self.num_heads,
         head_dim=self.head_dim, mlp_dim=self.mlp_dim,
         max_length=self.max_episode_length * k,
         attention_mode=self.attention_mode, mesh=self.mesh,
-        tp_axis=self.tp_axis, dropout_rate=self.dropout_rate,
+        tp_axis=self.tp_axis, moe_experts=self.moe_experts,
+        moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+        dropout_rate=self.dropout_rate,
         dtype=self.dtype, name='transformer')(tokens, train=train)
     # Last token of each frame: under the token-causal mask it has seen the
     # whole frame plus all history — the natural readout position.
     frame_out = encoded.reshape(b, t, k, -1)[:, :, -1, :]
     logits = nn.Dense(self.action_size * self.vocab_size, name='action_head',
                       dtype=jnp.float32)(frame_out)  # [B, T, A*V]
-    return SpecStruct(action_logits=logits)
+    outputs = SpecStruct(action_logits=logits)
+    if self.moe_experts:
+      outputs['moe_aux_loss'] = moe_aux
+    return outputs
 
 
 class Seq2ActBCModel(AbstractT2RModel):
@@ -214,6 +222,10 @@ class Seq2ActBCModel(AbstractT2RModel):
                attention_mode: str = 'auto',
                mesh: Optional[object] = None,
                tp_axis: Optional[str] = None,
+               moe_experts: int = 0,
+               moe_top_k: int = 2,
+               ep_axis: Optional[str] = None,
+               moe_aux_weight: float = 0.01,
                max_episode_length: Optional[int] = None,
                dropout_rate: float = 0.0,
                use_state_input: bool = False,
@@ -247,6 +259,10 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._attention_mode = attention_mode
     self._mesh = mesh
     self._tp_axis = tp_axis
+    self._moe_experts = moe_experts
+    self._moe_top_k = moe_top_k
+    self._ep_axis = ep_axis
+    self._moe_aux_weight = moe_aux_weight
     self._max_episode_length = max_episode_length or episode_length
     self._dropout_rate = dropout_rate
     self._use_state_input = use_state_input
@@ -293,6 +309,9 @@ class Seq2ActBCModel(AbstractT2RModel):
         attention_mode=self._attention_mode,
         mesh=self._mesh,
         tp_axis=self._tp_axis,
+        moe_experts=self._moe_experts,
+        moe_top_k=self._moe_top_k,
+        ep_axis=self._ep_axis,
         dropout_rate=self._dropout_rate,
         dtype=self.compute_dtype,
         use_state_input=self._use_state_input,
@@ -304,6 +323,8 @@ class Seq2ActBCModel(AbstractT2RModel):
     actions = jnp.asarray(labels[self.label_key], jnp.float32)
     loss = decoders.get_discrete_action_loss(
         logits, actions, self._bin_centers, self._vocab_size)
+    if self._moe_experts and 'moe_aux_loss' in inference_outputs:
+      loss = loss + self._moe_aux_weight * inference_outputs['moe_aux_loss']
     predicted = decoders.get_discrete_actions(
         logits, self._action_size, self._vocab_size, self._bin_centers)
     bin_width = (self._action_max - self._action_min) / self._vocab_size

@@ -19,7 +19,7 @@ class TestMesh:
 
   def test_explicit_axes(self):
     mesh = parallel.create_mesh({'data': 2, 'fsdp': 2, 'model': 2})
-    assert dict(mesh.shape) == {'data': 2, 'fsdp': 2, 'model': 2}
+    assert dict(mesh.shape) == {'data': 2, 'fsdp': 2, 'model': 2, 'expert': 1}
 
   def test_infer_axis(self):
     mesh = parallel.create_mesh({'data': -1, 'model': 2})

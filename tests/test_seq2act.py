@@ -85,10 +85,10 @@ class TestTransformerLayers:
     rng = np.random.RandomState(1)
     x = rng.randn(1, 12, 16).astype(np.float32)
     variables = model.init(jax.random.PRNGKey(0), x)
-    base = model.apply(variables, x)
+    base, _ = model.apply(variables, x)
     x2 = x.copy()
     x2[:, 9:] += 10.0  # perturb the future
-    out = model.apply(variables, x2)
+    out, _ = model.apply(variables, x2)
     np.testing.assert_allclose(np.asarray(out[:, :9]),
                                np.asarray(base[:, :9]), atol=1e-5)
     assert not np.allclose(np.asarray(out[:, 9:]), np.asarray(base[:, 9:]))
