@@ -309,7 +309,16 @@ class ImageTokenizer(nn.Module):
 
 
 class CausalTransformer(nn.Module):
-  """Token sequence model: learned positions + N causal blocks + final LN."""
+  """Token sequence model: learned positions + N causal blocks + final LN.
+
+  ``pipe_axis``: pipeline parallelism (parallel/pipeline.py). The blocks
+  become ONE stacked-param stage (leading dim = num_layers, sharded over
+  the pipe axis by PP_RULES_TRANSFORMER) and run as a GPipe pipeline with
+  ``pipeline_microbatches`` microbatches; positions and the final LN stay
+  outside the pipeline (replicated, cheap). Pipelined constraints:
+  num_layers must equal the pipe-axis size, dropout must be off, and MoE
+  blocks are not yet pipelined (both asserted at trace time).
+  """
 
   num_layers: int
   num_heads: int
@@ -323,8 +332,19 @@ class CausalTransformer(nn.Module):
   moe_experts: int = 0
   moe_top_k: int = 2
   ep_axis: Optional[str] = None
+  pipe_axis: Optional[str] = None
+  pipeline_microbatches: int = 2
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
+
+  def _block(self, name: Optional[str] = None) -> 'TransformerBlock':
+    return TransformerBlock(
+        num_heads=self.num_heads, head_dim=self.head_dim,
+        mlp_dim=self.mlp_dim, attention_mode=self.attention_mode,
+        causal=True, mesh=self.mesh, seq_axis=self.seq_axis,
+        tp_axis=self.tp_axis, moe_experts=self.moe_experts,
+        moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+        dropout_rate=self.dropout_rate, dtype=self.dtype, name=name)
 
   @nn.compact
   def __call__(self, tokens: jnp.ndarray, train: bool = False):
@@ -338,14 +358,44 @@ class CausalTransformer(nn.Module):
                      (self.max_length, d), jnp.float32)
     x = tokens + pos[None, :l].astype(tokens.dtype)
     aux_total = jnp.zeros((), jnp.float32)
-    for i in range(self.num_layers):
-      x, aux = TransformerBlock(
-          num_heads=self.num_heads, head_dim=self.head_dim,
-          mlp_dim=self.mlp_dim, attention_mode=self.attention_mode,
-          causal=True, mesh=self.mesh, seq_axis=self.seq_axis,
-          tp_axis=self.tp_axis, moe_experts=self.moe_experts,
-          moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
-          dropout_rate=self.dropout_rate,
-          dtype=self.dtype, name='block{}'.format(i))(x, train=train)
-      aux_total = aux_total + aux
+    if self.pipe_axis:
+      x = self._pipelined_blocks(x)
+    else:
+      for i in range(self.num_layers):
+        x, aux = self._block(name='block{}'.format(i))(x, train=train)
+        aux_total = aux_total + aux
     return nn.LayerNorm(dtype=jnp.float32, name='ln_final')(x), aux_total
+
+  def _pipelined_blocks(self, x: jnp.ndarray) -> jnp.ndarray:
+    from tensor2robot_tpu.parallel import pipeline as pipeline_lib
+
+    if self.mesh is None:
+      raise ValueError('pipe_axis requires a mesh.')
+    stages = int(self.mesh.shape.get(self.pipe_axis, 0))
+    if stages != self.num_layers:
+      raise ValueError(
+          'pipelined transformer needs num_layers ({}) == the {!r} axis '
+          'size ({}); one block per stage.'.format(
+              self.num_layers, self.pipe_axis, stages))
+    if self.dropout_rate or self.moe_experts:
+      raise ValueError('pipelined blocks do not support dropout or MoE '
+                       '(rngs/aux are not threaded through the pipeline).')
+    b, l, d = x.shape
+    block = self._block()
+
+    def init_stacked(rng):
+      rngs = jax.random.split(rng, stages)
+      return jax.vmap(
+          lambda r: block.init(r, jnp.zeros((1, l, d), x.dtype))['params']
+      )(rngs)
+
+    stacked = self.param('pipe_blocks', init_stacked)
+
+    def stage_fn(params, act):
+      out, _ = block.apply({'params': params}, act)
+      return out
+
+    mb = pipeline_lib.microbatch(x, self.pipeline_microbatches)
+    out = pipeline_lib.pipeline_apply(stage_fn, stacked, mb, self.mesh,
+                                      axis=self.pipe_axis)
+    return pipeline_lib.unmicrobatch(out)

@@ -5,11 +5,13 @@ from tensor2robot_tpu.parallel.mesh import (
     EXPERT_AXIS,
     FSDP_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     create_hybrid_mesh,
     create_mesh,
 )
 from tensor2robot_tpu.parallel.sharding import (
     EP_RULES_MOE,
+    PP_RULES_TRANSFORMER,
     TP_RULES_TRANSFORMER,
     batch_sharding,
     fsdp_param_spec,
@@ -19,6 +21,7 @@ from tensor2robot_tpu.parallel.sharding import (
     train_state_sharding,
 )
 from tensor2robot_tpu.parallel import collectives
+from tensor2robot_tpu.parallel import pipeline
 from tensor2robot_tpu.parallel.flash_attention import flash_attention
 from tensor2robot_tpu.parallel.ring_attention import (
     reference_attention,

@@ -30,7 +30,8 @@ DATA_AXIS = 'data'
 FSDP_AXIS = 'fsdp'
 MODEL_AXIS = 'model'
 EXPERT_AXIS = 'expert'
-DEFAULT_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, EXPERT_AXIS)
+PIPE_AXIS = 'pipe'
+DEFAULT_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, EXPERT_AXIS, PIPE_AXIS)
 
 
 def create_mesh(axis_sizes: Optional[Dict[str, int]] = None,

@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe microbatching over a 'pipe' mesh axis.
+
+The reference scales only by data parallelism (SURVEY.md §2.9); pipeline
+parallelism completes the framework's dp/fsdp/tp/sp/ep axis family for
+models whose layer stacks exceed one device's HBM.
+
+TPU-native shape: S pipeline stages live on S mesh shards. Inside one
+``shard_map``, every device runs the same ``lax.scan`` over
+``T = M + S - 1`` ticks (M microbatches); at each tick a device applies
+its resident stage to either the next microbatch (stage 0) or the
+activation received from its predecessor, then passes the result along
+the ring with ``lax.ppermute`` — the classic collective-permute pipeline,
+with the bubble (S - 1 idle ticks) explicit in T. The last stage
+predicated-writes its outputs into the result buffer, which a masked
+``psum`` replicates to all shards. Autodiff composes: ``ppermute``'s
+transpose is the reverse permute and ``scan`` stores per-tick residuals,
+so ``jax.grad`` through ``pipeline_apply`` runs the backward pipeline in
+reverse stage order (wrap ``stage_fn`` in ``jax.checkpoint`` to trade
+the stored residuals for recompute).
+
+Constraints (documented, asserted): uniform activation shape across
+stages (true of transformer blocks), stage params stacked on a leading
+S dim, microbatch count M >= 1. Schedule is GPipe (fill-drain), not
+1F1B — at the scale this framework targets (S <= 8 stages) the bubble
+fraction (S-1)/(M+S-1) is controlled by raising M.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tensor2robot_tpu.parallel import collectives
+from tensor2robot_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stage_params: Any,
+                   x: jnp.ndarray,
+                   mesh: Mesh,
+                   axis: str = PIPE_AXIS) -> jnp.ndarray:
+  """Applies S stacked stages to M microbatches, pipelined over ``axis``.
+
+  Args:
+    stage_fn: ``(params_for_one_stage, activation [mb, ...]) -> [mb, ...]``
+      — same activation shape in and out (uniform-width pipeline).
+    stage_params: pytree whose leaves lead with dim S == mesh.shape[axis];
+      leaf ``i`` holds stage i's params.
+    x: ``[M, mb, ...]`` microbatched input.
+    mesh: mesh containing ``axis``.
+
+  Returns:
+    ``[M, mb, ...]`` outputs of the final stage (replicated over ``axis``).
+  """
+  if axis not in mesh.shape:
+    raise ValueError('mesh has no {!r} axis (axes: {}).'.format(
+        axis, tuple(mesh.axis_names)))
+  s_count = int(mesh.shape[axis])
+  m_count = int(x.shape[0])
+  for leaf in jax.tree_util.tree_leaves(stage_params):
+    if leaf.shape[0] != s_count:
+      raise ValueError(
+          'stage_params leaves must lead with the stage count {}; got '
+          'leaf shape {}.'.format(s_count, leaf.shape))
+
+  param_spec = jax.tree.map(lambda _: P(axis), stage_params)
+  # Data parallelism composes INSIDE the shard_map: the per-microbatch
+  # batch dim of x shards over 'data' (when present and divisible), so
+  # each data replica pipelines only its slice — all collectives below run
+  # over the pipe axis only, which keeps the mb-dim sharding legal.
+  data_size = int(mesh.shape.get(DATA_AXIS, 1))
+  mb_axis = (DATA_AXIS
+             if data_size > 1 and x.shape[1] % data_size == 0 else None)
+  io_spec = P(None, mb_axis)
+
+  @collectives.sharded_fn(mesh, in_specs=(param_spec, io_spec),
+                          out_specs=io_spec)
+  def _run(params, x_all):
+    stage = jax.lax.axis_index(axis)
+    local_params = jax.tree.map(lambda p: p[0], params)  # [1,...] -> stage's
+
+    def tick(carry, t):
+      act, y = carry
+      mb_in = jax.lax.dynamic_index_in_dim(
+          x_all, jnp.clip(t, 0, m_count - 1), 0, keepdims=False)
+      cur = jnp.where(stage == 0, mb_in, act)
+      out = stage_fn(local_params, cur)
+      nxt = collectives.ring_permute(out, axis)
+      idx = t - (s_count - 1)
+      write = (idx >= 0) & (stage == s_count - 1)
+      slot = jnp.clip(idx, 0, m_count - 1)
+      prev = jax.lax.dynamic_index_in_dim(y, slot, 0, keepdims=False)
+      y = jax.lax.dynamic_update_index_in_dim(
+          y, jnp.where(write, out, prev), slot, 0)
+      return (nxt, y), None
+
+    act0 = jnp.zeros_like(x_all[0])
+    y0 = jnp.zeros_like(x_all)
+    (_, y), _ = jax.lax.scan(tick, (act0, y0),
+                             jnp.arange(m_count + s_count - 1))
+    # Replicate the last stage's buffer to every pipe shard.
+    return collectives.psum(
+        jnp.where(stage == s_count - 1, y, jnp.zeros_like(y)), axis)
+
+  return _run(stage_params, x)
+
+
+def microbatch(x: jnp.ndarray, num_microbatches: int) -> jnp.ndarray:
+  """[B, ...] -> [M, B/M, ...] for pipeline_apply."""
+  b = x.shape[0]
+  if b % num_microbatches:
+    raise ValueError('batch {} not divisible into {} microbatches.'.format(
+        b, num_microbatches))
+  return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(y: jnp.ndarray) -> jnp.ndarray:
+  """Inverse of :func:`microbatch`."""
+  return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])

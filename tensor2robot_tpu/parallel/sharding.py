@@ -17,7 +17,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tensor2robot_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
-                                             FSDP_AXIS, MODEL_AXIS)
+                                             FSDP_AXIS, MODEL_AXIS,
+                                             PIPE_AXIS)
 
 
 def constrain(x, mesh: Optional[Mesh], spec: P):
@@ -80,6 +81,16 @@ TP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
 EP_RULES_MOE: Tuple[Tuple[str, P], ...] = (
     (r'.*/moe/w_in$', P(EXPERT_AXIS, None, None)),
     (r'.*/moe/w_out$', P(EXPERT_AXIS, None, None)),
+)
+
+
+# Pipeline-parallel rules for CausalTransformer(pipe_axis=...): every leaf
+# under the stacked 'pipe_blocks' param leads with the stage dim, sharded
+# over 'pipe' (parallel/pipeline.py). When combining rule sets, put these
+# FIRST — the TP patterns also match .../pipe_blocks/attn/... paths but
+# would shard the wrong dim of the stage-stacked kernels.
+PP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
+    (r'.*/pipe_blocks/.*', P(PIPE_AXIS)),
 )
 
 

@@ -136,6 +136,8 @@ class RT1StyleNet(nn.Module):
   moe_experts: int = 0
   moe_top_k: int = 2
   ep_axis: Optional[str] = None
+  pipe_axis: Optional[str] = None
+  pipeline_microbatches: int = 2
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
   use_state_input: bool = False
@@ -186,6 +188,8 @@ class RT1StyleNet(nn.Module):
         attention_mode=self.attention_mode, mesh=self.mesh,
         tp_axis=self.tp_axis, moe_experts=self.moe_experts,
         moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+        pipe_axis=self.pipe_axis,
+        pipeline_microbatches=self.pipeline_microbatches,
         dropout_rate=self.dropout_rate,
         dtype=self.dtype, name='transformer')(tokens, train=train)
     # Last token of each frame: under the token-causal mask it has seen the
@@ -226,6 +230,8 @@ class Seq2ActBCModel(AbstractT2RModel):
                moe_top_k: int = 2,
                ep_axis: Optional[str] = None,
                moe_aux_weight: float = 0.01,
+               pipe_axis: Optional[str] = None,
+               pipeline_microbatches: int = 2,
                max_episode_length: Optional[int] = None,
                dropout_rate: float = 0.0,
                use_state_input: bool = False,
@@ -263,6 +269,8 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._moe_top_k = moe_top_k
     self._ep_axis = ep_axis
     self._moe_aux_weight = moe_aux_weight
+    self._pipe_axis = pipe_axis
+    self._pipeline_microbatches = pipeline_microbatches
     self._max_episode_length = max_episode_length or episode_length
     self._dropout_rate = dropout_rate
     self._use_state_input = use_state_input
@@ -312,6 +320,8 @@ class Seq2ActBCModel(AbstractT2RModel):
         moe_experts=self._moe_experts,
         moe_top_k=self._moe_top_k,
         ep_axis=self._ep_axis,
+        pipe_axis=self._pipe_axis,
+        pipeline_microbatches=self._pipeline_microbatches,
         dropout_rate=self._dropout_rate,
         dtype=self.compute_dtype,
         use_state_input=self._use_state_input,
