@@ -380,6 +380,15 @@ class CausalTransformer(nn.Module):
     if self.dropout_rate or self.moe_experts:
       raise ValueError('pipelined blocks do not support dropout or MoE '
                        '(rngs/aux are not threaded through the pipeline).')
+    if self.tp_axis or self.attention_mode == 'ring':
+      # Both run their own sharding machinery (with_sharding_constraint /
+      # a nested shard_map) inside pipeline_apply's shard_map body, where
+      # every mesh axis is already manual — fail clearly instead of deep
+      # inside JAX tracing.
+      raise ValueError('pipelined blocks cannot combine with tp_axis or '
+                       "attention_mode='ring' (nested sharding inside the "
+                       'pipeline shard_map); plain xla/flash attention '
+                       'works.')
     b, l, d = x.shape
     block = self._block()
 

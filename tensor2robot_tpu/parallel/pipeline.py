@@ -61,7 +61,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
   s_count = int(mesh.shape[axis])
   m_count = int(x.shape[0])
   for leaf in jax.tree_util.tree_leaves(stage_params):
-    if leaf.shape[0] != s_count:
+    if not getattr(leaf, 'shape', ()) or leaf.shape[0] != s_count:
       raise ValueError(
           'stage_params leaves must lead with the stage count {}; got '
           'leaf shape {}.'.format(s_count, leaf.shape))

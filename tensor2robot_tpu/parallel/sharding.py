@@ -67,12 +67,12 @@ def fsdp_param_spec(param, mesh: Mesh,
 # closes with a psum over 'model' (the Megatron f/g collectives, derived
 # by GSPMD from these placements instead of hand-written all-reduces).
 TP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
-    (r'.*/attn/qkv/kernel$', P(None, MODEL_AXIS)),
-    (r'.*/attn/qkv/bias$', P(MODEL_AXIS)),
-    (r'.*/attn/out/kernel$', P(MODEL_AXIS, None)),
-    (r'.*/mlp_in/kernel$', P(None, MODEL_AXIS)),
-    (r'.*/mlp_in/bias$', P(MODEL_AXIS)),
-    (r'.*/mlp_out/kernel$', P(MODEL_AXIS, None)),
+    (r'(?!.*pipe_blocks).*/attn/qkv/kernel$', P(None, MODEL_AXIS)),
+    (r'(?!.*pipe_blocks).*/attn/qkv/bias$', P(MODEL_AXIS)),
+    (r'(?!.*pipe_blocks).*/attn/out/kernel$', P(MODEL_AXIS, None)),
+    (r'(?!.*pipe_blocks).*/mlp_in/kernel$', P(None, MODEL_AXIS)),
+    (r'(?!.*pipe_blocks).*/mlp_in/bias$', P(MODEL_AXIS)),
+    (r'(?!.*pipe_blocks).*/mlp_out/kernel$', P(MODEL_AXIS, None)),
 )
 
 
@@ -86,9 +86,9 @@ EP_RULES_MOE: Tuple[Tuple[str, P], ...] = (
 
 # Pipeline-parallel rules for CausalTransformer(pipe_axis=...): every leaf
 # under the stacked 'pipe_blocks' param leads with the stage dim, sharded
-# over 'pipe' (parallel/pipeline.py). When combining rule sets, put these
-# FIRST — the TP patterns also match .../pipe_blocks/attn/... paths but
-# would shard the wrong dim of the stage-stacked kernels.
+# over 'pipe' (parallel/pipeline.py). Order-independent when combined with
+# the TP rules: those exclude pipe_blocks paths outright (negative
+# lookahead), and a declining rule falls through to later rules anyway.
 PP_RULES_TRANSFORMER: Tuple[Tuple[str, P], ...] = (
     (r'.*/pipe_blocks/.*', P(PIPE_AXIS)),
 )
@@ -119,18 +119,22 @@ def tp_param_spec(path_str: str, param, mesh: Mesh,
   """
   shape = getattr(param, 'shape', ())
   for pattern, spec in rules:
-    if re.match(pattern, path_str):
-      if len(spec) > len(shape):
-        return None
-      sharded_any = False
-      for dim, axis in enumerate(spec):
-        if axis is None:
-          continue
-        size = int(mesh.shape.get(axis, 1))
-        if size <= 1 or shape[dim] % size:
-          return None  # indivisible: replicate rather than mis-shard
-        sharded_any = True
-      return spec if sharded_any else None
+    if not re.match(pattern, path_str):
+      continue
+    if len(spec) > len(shape):
+      continue  # rule shaped for a different rank: try later rules
+    sharded_any = False
+    ok = True
+    for dim, axis in enumerate(spec):
+      if axis is None:
+        continue
+      size = int(mesh.shape.get(axis, 1))
+      if size <= 1 or shape[dim] % size:
+        ok = False  # indivisible: replicate rather than mis-shard
+        break
+      sharded_any = True
+    if ok and sharded_any:
+      return spec
   return None
 
 

@@ -19,7 +19,11 @@ class TestMesh:
 
   def test_explicit_axes(self):
     mesh = parallel.create_mesh({'data': 2, 'fsdp': 2, 'model': 2})
-    assert dict(mesh.shape) == {'data': 2, 'fsdp': 2, 'model': 2, 'expert': 1}
+    shape = dict(mesh.shape)
+    assert (shape['data'], shape['fsdp'], shape['model']) == (2, 2, 2)
+    # Unrequested default axes (expert, pipe, future ones) exist at size 1.
+    assert all(v == 1 for k, v in shape.items()
+               if k not in ('data', 'fsdp', 'model'))
 
   def test_infer_axis(self):
     mesh = parallel.create_mesh({'data': -1, 'model': 2})
@@ -441,3 +445,47 @@ class TestPipelineParallel:
         'params/transformer/pipe_blocks/attn/qkv/kernel', _Leaf, mesh,
         PP_RULES_TRANSFORMER)
     assert spec == P('pipe')
+
+
+class TestShardedCheckpoint:
+  """Orbax save/restore round-trip of a TP-sharded train state."""
+
+  def _make_trainer(self, mesh, d):
+    from tensor2robot_tpu.parallel.sharding import TP_RULES_TRANSFORMER
+    from tensor2robot_tpu.research.seq2act import Seq2ActBCModel
+    from tensor2robot_tpu.trainer import Trainer
+
+    model = Seq2ActBCModel(
+        episode_length=4, action_size=2, vocab_size=8, img_res=(32, 32),
+        src_img_res=(36, 36), tokens_per_frame=4, embed_dim=32,
+        num_layers=2, num_heads=4, head_dim=8, mlp_dim=64,
+        tokenizer_widths=(8, 8, 8, 16), attention_mode='xla',
+        mesh=mesh, tp_axis='model')
+    return Trainer(model, d, mesh=mesh, tp_rules=TP_RULES_TRANSFORMER,
+                   async_checkpoints=False, save_checkpoints_steps=2)
+
+  def test_tp_checkpoint_roundtrip(self, tmp_path):
+    """A fresh Trainer restores the sharded checkpoint into its
+    NamedSharding template, keeps the 'model' placement, and resumes the
+    step count — the restore path itself runs on sharded leaves."""
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRandomInputGenerator,
+    )
+
+    mesh = parallel.create_mesh({'data': 2, 'model': 4})
+    gen = DefaultRandomInputGenerator(batch_size=8)
+    d = str(tmp_path / 'run')
+
+    trainer = self._make_trainer(mesh, d)
+    state = trainer.train(gen, max_train_steps=2)
+    assert int(jax.device_get(state.step)) == 2
+    trainer.close()
+
+    trainer2 = self._make_trainer(mesh, d)
+    state2 = trainer2.train(gen, max_train_steps=4)  # must resume at 2
+    assert int(jax.device_get(state2.step)) == 4
+    qkv = [l for p, l in jax.tree_util.tree_flatten_with_path(
+               state2.params)[0]
+           if jax.tree_util.keystr(p).endswith("qkv']['kernel']")]
+    assert qkv and all('model' in str(l.sharding.spec) for l in qkv)
+    trainer2.close()
