@@ -178,3 +178,21 @@ def register_all() -> None:
   from tensor2robot_tpu.research import seq2act
   register(seq2act.Seq2ActBCModel, 'Seq2ActBCModel')
   register(seq2act.Seq2ActPreprocessor, 'Seq2ActPreprocessor')
+
+  # Parallelism rule sets for train_eval_model.tp_rules (zero-arg
+  # factories so configs can bind @TP_RULES_TRANSFORMER() etc.; they
+  # concatenate in any order — docs/parallelism.md).
+  from tensor2robot_tpu.parallel import sharding as sharding_rules
+
+  def _tp_rules_transformer():
+    return sharding_rules.TP_RULES_TRANSFORMER
+
+  def _ep_rules_moe():
+    return sharding_rules.EP_RULES_MOE
+
+  def _pp_rules_transformer():
+    return sharding_rules.PP_RULES_TRANSFORMER
+
+  register(_tp_rules_transformer, 'TP_RULES_TRANSFORMER')
+  register(_ep_rules_moe, 'EP_RULES_MOE')
+  register(_pp_rules_transformer, 'PP_RULES_TRANSFORMER')

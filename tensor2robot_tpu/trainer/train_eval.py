@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,7 +72,7 @@ class Trainer:
                model_dir: str,
                mesh: Optional[Mesh] = None,
                use_fsdp: bool = False,
-               tp_rules=None,
+               tp_rules: Optional[Sequence[Tuple[str, Any]]] = None,
                seed: int = 0,
                keep_checkpoint_max: int = 5,
                save_checkpoints_steps: int = 500,
@@ -552,6 +552,7 @@ def train_eval_model(t2r_model: AbstractT2RModel,
                      train_hook_builders: Sequence[Any] = (),
                      mesh: Optional[Mesh] = None,
                      use_fsdp: bool = False,
+                     tp_rules: Optional[Sequence[Tuple[str, Any]]] = None,
                      keep_checkpoint_max: int = 5,
                      save_checkpoints_steps: int = 500,
                      async_checkpoints: bool = True,
@@ -582,7 +583,8 @@ def train_eval_model(t2r_model: AbstractT2RModel,
     # TF_CONFIG.multi_eval_name (ref utils/train_eval.py:522-547).
     eval_name = getattr(input_generator_eval, 'multi_eval_name', None)
   trainer = Trainer(
-      t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp, seed=seed,
+      t2r_model, model_dir, mesh=mesh, use_fsdp=use_fsdp,
+      tp_rules=tp_rules, seed=seed,
       keep_checkpoint_max=keep_checkpoint_max,
       save_checkpoints_steps=save_checkpoints_steps,
       async_checkpoints=async_checkpoints,

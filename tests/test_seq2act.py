@@ -397,18 +397,33 @@ class TestServingPolicy:
     predictor.close()
 
 
+def _parse_seq2act_config(config_name):
+  """Clears global config state, then parses one seq2act gin file."""
+  import os
+  from tensor2robot_tpu import config
+  from tensor2robot_tpu.config import ginlike
+  ginlike.clear_config()
+  repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  config.register_framework_configurables()
+  config.add_config_file_search_path(repo_root)
+  config.parse_config_files_and_bindings(
+      [os.path.join(repo_root, 'tensor2robot_tpu/research/seq2act/configs/',
+                    config_name)],
+      ['Seq2ActBCModel.device_type = "cpu"'])
+  return config
+
+
+@pytest.fixture()
+def _clean_config_after():
+  yield
+  from tensor2robot_tpu.config import ginlike
+  ginlike.clear_config()
+
+
 class TestConfig:
 
-  def test_gin_config_parses_and_builds_model(self):
-    import os
-    from tensor2robot_tpu import config
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    config.register_framework_configurables()
-    config.add_config_file_search_path(repo_root)
-    config.parse_config_files_and_bindings(
-        [os.path.join(repo_root, 'tensor2robot_tpu/research/seq2act/configs/'
-                      'train_seq2act_bc.gin')],
-        ['Seq2ActBCModel.device_type = "cpu"'])
+  def test_gin_config_parses_and_builds_model(self, _clean_config_after):
+    config = _parse_seq2act_config('train_seq2act_bc.gin')
     model = config.query_parameter('train_eval_model.t2r_model')
     assert isinstance(model, Seq2ActBCModel)
     assert model.episode_length == 6
@@ -456,3 +471,16 @@ class TestRingAttention:
     new_state, metrics = step(state, feats, labs, jax.random.PRNGKey(2))
     assert int(jax.device_get(new_state.step)) == 1
     assert np.isfinite(float(metrics['loss']))
+
+
+class TestMoEConfig:
+
+  def test_moe_gin_config_builds_and_wires_rules(self, _clean_config_after):
+    config = _parse_seq2act_config('train_seq2act_moe.gin')
+    model = config.query_parameter('train_eval_model.t2r_model')
+    assert isinstance(model, Seq2ActBCModel)
+    assert model._moe_experts == 8
+    assert model._ep_axis == 'expert'
+    rules = config.query_parameter('train_eval_model.tp_rules')
+    from tensor2robot_tpu.parallel.sharding import EP_RULES_MOE
+    assert tuple(rules) == tuple(EP_RULES_MOE)
