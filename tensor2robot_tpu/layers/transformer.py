@@ -334,6 +334,7 @@ class CausalTransformer(nn.Module):
   ep_axis: Optional[str] = None
   pipe_axis: Optional[str] = None
   pipeline_microbatches: int = 2
+  pipeline_remat: bool = False
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
 
@@ -406,5 +407,6 @@ class CausalTransformer(nn.Module):
 
     mb = pipeline_lib.microbatch(x, self.pipeline_microbatches)
     out = pipeline_lib.pipeline_apply(stage_fn, stacked, mb, self.mesh,
-                                      axis=self.pipe_axis)
+                                      axis=self.pipe_axis,
+                                      remat=self.pipeline_remat)
     return pipeline_lib.unmicrobatch(out)

@@ -15,8 +15,8 @@ predicated-writes its outputs into the result buffer, which a masked
 ``psum`` replicates to all shards. Autodiff composes: ``ppermute``'s
 transpose is the reverse permute and ``scan`` stores per-tick residuals,
 so ``jax.grad`` through ``pipeline_apply`` runs the backward pipeline in
-reverse stage order (wrap ``stage_fn`` in ``jax.checkpoint`` to trade
-the stored residuals for recompute).
+reverse stage order (pass ``remat=True`` to trade the scan's stored
+per-tick residuals for recompute via ``jax.checkpoint``).
 
 Constraints (documented, asserted): uniform activation shape across
 stages (true of transformer blocks), stage params stacked on a leading
@@ -41,7 +41,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                    stage_params: Any,
                    x: jnp.ndarray,
                    mesh: Mesh,
-                   axis: str = PIPE_AXIS) -> jnp.ndarray:
+                   axis: str = PIPE_AXIS,
+                   remat: bool = False) -> jnp.ndarray:
   """Applies S stacked stages to M microbatches, pipelined over ``axis``.
 
   Args:
@@ -51,6 +52,11 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
       leaf ``i`` holds stage i's params.
     x: ``[M, mb, ...]`` microbatched input.
     mesh: mesh containing ``axis``.
+    remat: rematerialize each stage in the backward (``jax.checkpoint``
+      around ``stage_fn``) — the scan otherwise stores every tick's
+      stage residuals, O(T) activation memory per device; with remat it
+      stores only the tick inputs and recomputes, the standard GPipe
+      memory/compute trade.
 
   Returns:
     ``[M, mb, ...]`` outputs of the final stage (replicated over ``axis``).
@@ -66,6 +72,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
           'stage_params leaves must lead with the stage count {}; got '
           'leaf shape {}.'.format(s_count, leaf.shape))
 
+  run_stage = jax.checkpoint(stage_fn) if remat else stage_fn
   param_spec = jax.tree.map(lambda _: P(axis), stage_params)
   # Data parallelism composes INSIDE the shard_map: the per-microbatch
   # batch dim of x shards over 'data' (when present and divisible), so
@@ -87,7 +94,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
       mb_in = jax.lax.dynamic_index_in_dim(
           x_all, jnp.clip(t, 0, m_count - 1), 0, keepdims=False)
       cur = jnp.where(stage == 0, mb_in, act)
-      out = stage_fn(local_params, cur)
+      out = run_stage(local_params, cur)
       nxt = collectives.ring_permute(out, axis)
       idx = t - (s_count - 1)
       write = (idx >= 0) & (stage == s_count - 1)

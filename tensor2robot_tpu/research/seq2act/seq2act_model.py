@@ -138,6 +138,7 @@ class RT1StyleNet(nn.Module):
   ep_axis: Optional[str] = None
   pipe_axis: Optional[str] = None
   pipeline_microbatches: int = 2
+  pipeline_remat: bool = False
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
   use_state_input: bool = False
@@ -190,6 +191,7 @@ class RT1StyleNet(nn.Module):
         moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
         pipe_axis=self.pipe_axis,
         pipeline_microbatches=self.pipeline_microbatches,
+        pipeline_remat=self.pipeline_remat,
         dropout_rate=self.dropout_rate,
         dtype=self.dtype, name='transformer')(tokens, train=train)
     # Last token of each frame: under the token-causal mask it has seen the
@@ -232,6 +234,7 @@ class Seq2ActBCModel(AbstractT2RModel):
                moe_aux_weight: float = 0.01,
                pipe_axis: Optional[str] = None,
                pipeline_microbatches: int = 2,
+               pipeline_remat: bool = False,
                max_episode_length: Optional[int] = None,
                dropout_rate: float = 0.0,
                use_state_input: bool = False,
@@ -271,6 +274,7 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._moe_aux_weight = moe_aux_weight
     self._pipe_axis = pipe_axis
     self._pipeline_microbatches = pipeline_microbatches
+    self._pipeline_remat = pipeline_remat
     self._max_episode_length = max_episode_length or episode_length
     self._dropout_rate = dropout_rate
     self._use_state_input = use_state_input
@@ -322,6 +326,7 @@ class Seq2ActBCModel(AbstractT2RModel):
         ep_axis=self._ep_axis,
         pipe_axis=self._pipe_axis,
         pipeline_microbatches=self._pipeline_microbatches,
+        pipeline_remat=self._pipeline_remat,
         dropout_rate=self._dropout_rate,
         dtype=self.compute_dtype,
         use_state_input=self._use_state_input,

@@ -376,6 +376,23 @@ class TestPipelineParallel:
                                np.asarray(self._oracle(params, x))[0],
                                atol=1e-5)
 
+  def test_remat_matches_no_remat_gradients(self):
+    from tensor2robot_tpu.parallel import pipeline
+
+    mesh = parallel.create_mesh({'pipe': 4, 'data': 2})
+    params = self._stages(seed=7)
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(4, 2, 16).astype(np.float32))
+
+    def loss(p, remat):
+      return jnp.sum(jnp.sin(pipeline.pipeline_apply(
+          self._stage_fn, p, x, mesh, axis='pipe', remat=remat)))
+
+    g_plain = jax.grad(lambda p: loss(p, False))(params)
+    g_remat = jax.grad(lambda p: loss(p, True))(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), g_plain, g_remat)
+
   def test_bad_configs_raise(self):
     from tensor2robot_tpu.parallel import pipeline
 
