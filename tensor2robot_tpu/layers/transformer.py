@@ -312,12 +312,18 @@ class CausalTransformer(nn.Module):
   """Token sequence model: learned positions + N causal blocks + final LN.
 
   ``pipe_axis``: pipeline parallelism (parallel/pipeline.py). The blocks
-  become ONE stacked-param stage (leading dim = num_layers, sharded over
-  the pipe axis by PP_RULES_TRANSFORMER) and run as a GPipe pipeline with
+  become ONE stacked param tree (``pipe_blocks``, leading dims
+  ``[S, k]`` = [stage, block-within-stage], stage dim sharded over the
+  pipe axis by PP_RULES_TRANSFORMER) and run as a GPipe pipeline with
   ``pipeline_microbatches`` microbatches; positions and the final LN stay
-  outside the pipeline (replicated, cheap). Pipelined constraints:
-  num_layers must equal the pipe-axis size, dropout must be off, and MoE
-  blocks are not yet pipelined (both asserted at trace time).
+  outside the pipeline (replicated, cheap). Each stage runs
+  ``num_layers / |pipe|`` consecutive blocks (virtual stages), so layer
+  count only needs to be divisible by — not equal to — the stage count.
+  Pipelined constraints (asserted at trace time): divisibility, no
+  dropout, and no MoE/tp/ring inside the pipeline. NOTE: round 4's
+  virtual-stage change moved pipe_blocks leaves from [L, ...] to
+  [S, k, ...]; pipelined checkpoints saved before it need a one-off
+  reshape (k == 1 splits the leading dim) — none are shipped in-tree.
   """
 
   num_layers: int
@@ -373,11 +379,12 @@ class CausalTransformer(nn.Module):
     if self.mesh is None:
       raise ValueError('pipe_axis requires a mesh.')
     stages = int(self.mesh.shape.get(self.pipe_axis, 0))
-    if stages != self.num_layers:
+    if stages < 1 or self.num_layers % stages:
       raise ValueError(
-          'pipelined transformer needs num_layers ({}) == the {!r} axis '
-          'size ({}); one block per stage.'.format(
-              self.num_layers, self.pipe_axis, stages))
+          'pipelined transformer needs num_layers ({}) divisible by the '
+          '{!r} axis size ({}); each stage runs num_layers/|pipe| blocks.'
+          .format(self.num_layers, self.pipe_axis, stages))
+    blocks_per_stage = self.num_layers // stages
     if self.dropout_rate or self.moe_experts:
       raise ValueError('pipelined blocks do not support dropout or MoE '
                        '(rngs/aux are not threaded through the pipeline).')
@@ -394,16 +401,22 @@ class CausalTransformer(nn.Module):
     block = self._block()
 
     def init_stacked(rng):
-      rngs = jax.random.split(rng, stages)
-      return jax.vmap(
+      # Leading dims [S, k]: stage-major so leaf i on the stage axis holds
+      # stage i's k consecutive blocks (layer order = stage*k + j).
+      rngs = jax.random.split(rng, stages * blocks_per_stage)
+      rngs = rngs.reshape((stages, blocks_per_stage) + rngs.shape[1:])
+      return jax.vmap(jax.vmap(
           lambda r: block.init(r, jnp.zeros((1, l, d), x.dtype))['params']
-      )(rngs)
+      ))(rngs)
 
     stacked = self.param('pipe_blocks', init_stacked)
 
     def stage_fn(params, act):
-      out, _ = block.apply({'params': params}, act)
-      return out
+      # params leaves: [k, ...] — apply the stage's k blocks in order.
+      for j in range(blocks_per_stage):
+        act, _ = block.apply(
+            {'params': jax.tree.map(lambda p: p[j], params)}, act)
+      return act
 
     mb = pipeline_lib.microbatch(x, self.pipeline_microbatches)
     out = pipeline_lib.pipeline_apply(stage_fn, stacked, mb, self.mesh,

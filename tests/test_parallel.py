@@ -416,10 +416,7 @@ class TestPipelineParallel:
     Same stacked params evaluated both ways: pipelined over pipe(4) and
     as a plain loop via the block template.
     """
-    import flax.linen as nn
-
     from tensor2robot_tpu.layers import transformer as transformer_lib
-    from tensor2robot_tpu.parallel import pipeline as pipeline_lib
 
     mesh = parallel.create_mesh({'pipe': 4, 'data': 2})
     model = transformer_lib.CausalTransformer(
@@ -432,20 +429,56 @@ class TestPipelineParallel:
     got, aux = model.apply(variables, x)
     assert float(aux) == 0.0
 
-    # Oracle: run the same stacked block params sequentially.
+    # Oracle: run the same stacked block params sequentially (leading
+    # dims [S, k] — stage-major, k blocks per stage).
+    ref = self._sequential_oracle(variables, x, stages=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+  @staticmethod
+  def _sequential_oracle(variables, x, stages):
+    import flax.linen as nn
+
+    from tensor2robot_tpu.layers import transformer as transformer_lib
+
     block = transformer_lib.TransformerBlock(
         num_heads=2, head_dim=8, mlp_dim=32, attention_mode='xla',
         causal=True)
     stacked = variables['params']['pipe_blocks']
     pos = variables['params']['pos_embedding']
-    h = x + jnp.asarray(pos)[None, :12]
-    for i in range(4):
-      h, _ = block.apply(
-          {'params': jax.tree.map(lambda p: p[i], stacked)}, h)
+    h = x + jnp.asarray(pos)[None, :x.shape[1]]
+    k = jax.tree_util.tree_leaves(stacked)[0].shape[1]
+    for i in range(stages):
+      for j in range(k):
+        h, _ = block.apply(
+            {'params': jax.tree.map(lambda p: p[i][j], stacked)}, h)
     ln = variables['params']['ln_final']
-    ref = nn.LayerNorm().apply({'params': ln}, h)
+    return nn.LayerNorm().apply({'params': ln}, h)
+
+  def test_pipelined_virtual_stages_match_sequential(self):
+    """8 layers on 4 stages: each stage applies 2 consecutive blocks."""
+    from tensor2robot_tpu.layers import transformer as transformer_lib
+
+    mesh = parallel.create_mesh({'pipe': 4, 'data': 2})
+    model = transformer_lib.CausalTransformer(
+        num_layers=8, num_heads=2, head_dim=8, mlp_dim=32, max_length=16,
+        attention_mode='xla', mesh=mesh, pipe_axis='pipe',
+        pipeline_microbatches=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 12, 16).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(1), x)
+    got, _ = model.apply(variables, x)
+    ref = self._sequential_oracle(variables, x, stages=4)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
-    del pipeline_lib
+
+  def test_pipelined_indivisible_layers_raise(self):
+    from tensor2robot_tpu.layers import transformer as transformer_lib
+
+    mesh = parallel.create_mesh({'pipe': 4, 'data': 2})
+    model = transformer_lib.CausalTransformer(
+        num_layers=6, num_heads=2, head_dim=8, mlp_dim=32, max_length=16,
+        attention_mode='xla', mesh=mesh, pipe_axis='pipe')
+    with pytest.raises(ValueError, match='divisible'):
+      model.init(jax.random.PRNGKey(0), jnp.zeros((2, 12, 16)))
 
   def test_pipelined_transformer_param_rule(self):
     from tensor2robot_tpu.parallel.sharding import (
