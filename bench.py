@@ -460,6 +460,26 @@ def _grasp2vec_attempt(model, mesh, batch_size, n_steps):
   return batch_size * n_steps / dt, flops * n_steps / dt
 
 
+
+def _chained_steps(step_fn, batch, rng, n_steps: int):
+  """One jitted fn running n_steps train steps with donated state.
+
+  The per-dispatch tunnel latency that swings python-loop timings of
+  small steps is excluded by construction; donation keeps the python
+  loop's state-buffer reuse (the inner step's donation is ignored once
+  inlined into this trace).
+  """
+  import jax
+
+  def _chain(st):
+    def body(_, s):
+      new_state, _ = step_fn(s, batch['features'], batch['labels'], rng)
+      return new_state
+    return jax.lax.fori_loop(0, n_steps, body, st)
+
+  return jax.jit(_chain, donate_argnums=(0,))
+
+
 def _bench_seq2act(mesh, on_tpu: bool):
   """Transformer BC workload throughput (VERDICT item 3)."""
   import jax
@@ -476,18 +496,8 @@ def _bench_seq2act(mesh, on_tpu: bool):
     try:
       # Chain the steps inside ONE jit (the CEM metric's method): the
       # ~15 ms step is small enough that per-dispatch tunnel latency
-      # variance swung python-loop measurements ~50% between runs;
-      # state threads through the fori_loop so nothing hoists.
-      def _chain(st):
-        def body(_, s):
-          new_state, _ = step_fn(s, batch['features'], batch['labels'],
-                                 rng)
-          return new_state
-        return jax.lax.fori_loop(0, n_steps, body, st)
-
-      # donate_argnums keeps the python loop's state-buffer reuse (the
-      # inner step's donation is ignored once inlined into this trace).
-      chain = jax.jit(_chain, donate_argnums=(0,))
+      # variance swung python-loop measurements ~50% between runs.
+      chain = _chained_steps(step_fn, batch, rng, n_steps)
       state = chain(state)
       _sync(state)
 
@@ -690,14 +700,7 @@ def _bench_seq2act_long(mesh, on_tpu: bool) -> float:
     try:
       # Chained inside one jit with donated state, like the short
       # seq2act field — per-dispatch tunnel latency excluded.
-      def _chain(st):
-        def body(_, s):
-          new_state, _ = step_fn(s, batch['features'], batch['labels'],
-                                 rng)
-          return new_state
-        return jax.lax.fori_loop(0, n_steps, body, st)
-
-      chain = jax.jit(_chain, donate_argnums=(0,))
+      chain = _chained_steps(step_fn, batch, rng, n_steps)
       state = chain(state)
       _sync(state)
       t0 = time.time()
