@@ -500,7 +500,8 @@ class TestPipelineParallel:
 class TestShardedCheckpoint:
   """Orbax save/restore round-trip of a TP-sharded train state."""
 
-  def _make_trainer(self, mesh, d):
+  def _make_trainer(self, mesh, d, tokenizer_widths=(8, 8, 8, 16),
+                    use_fsdp=False, save_steps=2):
     from tensor2robot_tpu.parallel.sharding import TP_RULES_TRANSFORMER
     from tensor2robot_tpu.research.seq2act import Seq2ActBCModel
     from tensor2robot_tpu.trainer import Trainer
@@ -509,10 +510,11 @@ class TestShardedCheckpoint:
         episode_length=4, action_size=2, vocab_size=8, img_res=(32, 32),
         src_img_res=(36, 36), tokens_per_frame=4, embed_dim=32,
         num_layers=2, num_heads=4, head_dim=8, mlp_dim=64,
-        tokenizer_widths=(8, 8, 8, 16), attention_mode='xla',
+        tokenizer_widths=tokenizer_widths, attention_mode='xla',
         mesh=mesh, tp_axis='model')
     return Trainer(model, d, mesh=mesh, tp_rules=TP_RULES_TRANSFORMER,
-                   async_checkpoints=False, save_checkpoints_steps=2)
+                   use_fsdp=use_fsdp, async_checkpoints=False,
+                   save_checkpoints_steps=save_steps)
 
   def test_tp_checkpoint_roundtrip(self, tmp_path):
     """A fresh Trainer restores the sharded checkpoint into its
@@ -539,3 +541,34 @@ class TestShardedCheckpoint:
            if jax.tree_util.keystr(p).endswith("qkv']['kernel']")]
     assert qkv and all('model' in str(l.sharding.spec) for l in qkv)
     trainer2.close()
+
+  def test_tp_composes_with_fsdp(self, tmp_path):
+    """data x fsdp x model: TP params shard over 'model', everything else
+    falls back to FSDP ('fsdp') or replication — the composition
+    docs/parallelism.md promises."""
+    from tensor2robot_tpu.data.input_generators import (
+        DefaultRandomInputGenerator,
+    )
+
+    mesh = parallel.create_mesh({'data': 2, 'fsdp': 2, 'model': 2})
+    # The widened last tokenizer width makes its conv3 kernel
+    # [3, 3, 8, 256] (18,432 elems) cross fsdp_param_spec's
+    # min_size_to_shard (2**14), so the FSDP fallback actually engages
+    # in this tiny config.
+    gen = DefaultRandomInputGenerator(batch_size=8)
+    trainer = self._make_trainer(mesh, str(tmp_path),
+                                 tokenizer_widths=(8, 8, 8, 256),
+                                 use_fsdp=True, save_steps=10**9)
+    state = trainer.train(gen, max_train_steps=1)
+    assert int(jax.device_get(state.step)) == 1
+    shardings = {
+        jax.tree_util.keystr(path): str(leaf.sharding.spec)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state.params)[0]}
+    qkv = {p: s for p, s in shardings.items()
+           if p.endswith("qkv']['kernel']")}
+    assert qkv and all('model' in s for s in qkv.values()), qkv
+    # The large non-TP param (tokenizer conv3 kernel) takes the FSDP path.
+    fsdp_leaves = [p for p, s in shardings.items() if 'fsdp' in s]
+    assert any('conv3' in p for p in fsdp_leaves), shardings
+    trainer.close()
