@@ -489,14 +489,17 @@ def _bench_seq2act(mesh, on_tpu: bool):
   model = Seq2ActBCModel(device_type='tpu' if on_tpu else 'cpu',
                          attention_mode='auto')
   batch_size = 32 if on_tpu else 2
-  n_steps = 10 if on_tpu else 1
+  # 800 chained steps (~5 s per dispatch at the ~6.4 ms device step):
+  # the tunnel's +-tens-of-ms round-trip variance becomes ~1% of the
+  # measurement; the 10/50/200/400/800 sweep in docs/performance.md
+  # shows the measured rate converging as the per-dispatch overhead
+  # amortizes.
+  n_steps = 800 if on_tpu else 1
   with tempfile.TemporaryDirectory() as tmp:
     trainer, state, step_fn, rng, batch = _trainer_step_setup(
         model, mesh, batch_size, tmp)
     try:
-      # Chain the steps inside ONE jit (the CEM metric's method): the
-      # ~15 ms step is small enough that per-dispatch tunnel latency
-      # variance swung python-loop measurements ~50% between runs.
+      # Chain the steps inside ONE jit (the CEM metric's method).
       chain = _chained_steps(step_fn, batch, rng, n_steps)
       state = chain(state)
       _sync(state)
