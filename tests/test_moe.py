@@ -156,3 +156,26 @@ class TestExpertParallel:
 
     w_in = [s for path, s in shardings.items() if path.endswith("'w_in']")]
     assert w_in and all('expert' in str(s.spec) for s in w_in), shardings
+
+
+class TestMoEDtypes:
+
+  def test_bfloat16_activations_finite_and_close(self):
+    """The bf16 path (production compute dtype): the router still runs
+    in f32 (on the bf16-rounded input, so statistics match to input
+    precision) and outputs stay near the f32 oracle."""
+    layer32 = MoEMlp(num_experts=4, expert_dim=32, top_k=2,
+                     capacity_factor=4.0)
+    layer16 = MoEMlp(num_experts=4, expert_dim=32, top_k=2,
+                     capacity_factor=4.0, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 16, 8).astype(np.float32)
+    variables = layer32.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out32, aux32 = layer32.apply(variables, jnp.asarray(x))
+    out16, aux16 = layer16.apply(variables, jnp.asarray(x, jnp.bfloat16))
+    assert out16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out16, np.float32),
+                               np.asarray(out32), atol=0.05, rtol=0.05)
+    # Router runs in f32 in both; the only drift is the bf16-rounded
+    # input it sees.
+    np.testing.assert_allclose(float(aux16), float(aux32), rtol=1e-3)

@@ -484,3 +484,24 @@ class TestMoEConfig:
     rules = config.query_parameter('train_eval_model.tp_rules')
     from tensor2robot_tpu.parallel.sharding import EP_RULES_MOE
     assert tuple(rules) == tuple(EP_RULES_MOE)
+
+
+class TestAttentionModeResolution:
+
+  def test_resolution_rules(self, monkeypatch):
+    from tensor2robot_tpu.layers import transformer as transformer_lib
+
+    resolve = transformer_lib.resolve_attention_mode
+    # Non-auto modes pass through untouched.
+    assert resolve('flash', 64) == 'flash'
+    assert resolve('ring', 1 << 20) == 'ring'
+    assert resolve('xla', 1 << 20) == 'xla'
+    # auto by backend: dense on CPU, flash on TPU for long aligned L.
+    monkeypatch.setattr(transformer_lib.jax, 'default_backend',
+                        lambda: 'cpu')
+    assert resolve('auto', 4096) == 'xla'
+    monkeypatch.setattr(transformer_lib.jax, 'default_backend',
+                        lambda: 'tpu')
+    assert resolve('auto', 4096) == 'flash'
+    assert resolve('auto', 100) == 'xla'      # below _FLASH_MIN_LENGTH
+    assert resolve('auto', 4100) == 'xla'     # 128-misaligned
