@@ -266,3 +266,27 @@ class AbstractExportGenerator:
 class DefaultExportGenerator(AbstractExportGenerator):
   """The standard generator (ref default_export_generator.py:47): in-graph
   preprocessing + numpy receiver semantics."""
+
+
+class VariablesExportGenerator(AbstractExportGenerator):
+  """Variables-only artifact: no StableHLO predict fn, no warmup batch.
+
+  For high-frequency export consumers that are in-process and already hold
+  the model class — the filesystem target-network loop (rl/offpolicy.py
+  polls the lagged dir every few train steps; re-lowering the serving
+  function per export would dominate the update interval). The artifact
+  keeps the directory contract (specs, global step, atomic commit), minus
+  ``predict_fn.jaxexport`` and ``warmup_requests.npz``.
+  """
+
+  def serialize_predict_fn(self, variables, features):
+    del variables, features
+    return None
+
+  def export(self, export_root: str, variables, global_step: int,
+             batch_size: int = 1, version: Optional[int] = None) -> str:
+    del batch_size
+    return write_serving_artifact(
+        export_root, variables, self.serving_feature_spec(),
+        self.model.get_label_specification(ModeKeys.PREDICT), global_step,
+        version=version, raw_receivers=self._export_raw_receivers)

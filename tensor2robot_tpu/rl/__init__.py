@@ -2,5 +2,10 @@
 
 from tensor2robot_tpu.rl.run_env import run_env
 from tensor2robot_tpu.rl.collect_eval import collect_eval_loop
+from tensor2robot_tpu.rl.offpolicy import (
+    BellmanQTOptTrainer,
+    pairwise_ranking_accuracy,
+)
 
-__all__ = ['collect_eval_loop', 'run_env']
+__all__ = ['collect_eval_loop', 'run_env', 'BellmanQTOptTrainer',
+           'pairwise_ranking_accuracy']
