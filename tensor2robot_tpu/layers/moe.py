@@ -12,11 +12,19 @@ dispatch in its TPU-native form:
   Switch-style overflow semantics.
 * **Experts as stacked params.** All experts live in single
   [E, d, h]/[E, h, d] tensors computed with einsums over the expert dim;
-  under expert parallelism those params and the [E, C, d] dispatched
-  activations carry a ``P('expert', ...)`` sharding
-  (EP_RULES_MOE in parallel/sharding.py + the in-layer constraints) and
-  GSPMD lowers the dispatch/combine einsums to all-to-alls over the
-  'expert' axis — the MoE communication pattern, derived not hand-coded.
+  under expert parallelism the params carry a ``P('expert', ...)``
+  sharding (EP_RULES_MOE in parallel/sharding.py).
+* **Explicit all-to-all dispatch under EP.** With ``ep_axis`` set, the
+  expert computation runs in a shard_map: the TOKEN dim is split over
+  the expert axis (GShard's groups — each shard routes its L/N tokens
+  locally), ``lax.all_to_all`` exchanges the per-expert buffers so each
+  shard holds ALL groups' tokens for its E/N resident experts, and a
+  second all-to-all routes results back. Measured against leaving the
+  einsums to GSPMD (which lowers this pattern to all-gathers + a
+  combine all-reduce over the full [B, L, d] activations): the a2a
+  pair moves ~2*k*C*d/N bytes per device vs ~3*B*L*d for the
+  gather/reduce pattern — the difference between communication that
+  SHRINKS with the expert axis and communication that does not.
 * **Router in f32** (logits, softmax, and the load-balancing auxiliary
   loss) regardless of the activation dtype: top-k ties and the aux-loss
   gradients are precision-sensitive at bf16.
@@ -39,13 +47,50 @@ import numpy as np
 from tensor2robot_tpu.parallel.sharding import constrain
 
 
+def _capacity(k: int, tokens: int, factor: float, num_experts: int) -> int:
+  """Per-expert slots for a token group: ceil(k*T*f/E), 8-aligned, <= T."""
+  capacity = int(np.ceil(k * tokens * factor / num_experts))
+  capacity = max(8, -(-capacity // 8) * 8)
+  return min(capacity, tokens)
+
+
+def _dispatch_combine(probs, expert_idx, num_experts: int, k: int,
+                      capacity: int):
+  """(dispatch, combine) one-hot tensors [B, T, E, C] for one token group.
+
+  Position of each (token, choice) in its expert's buffer is the running
+  count of earlier assignments to that expert (k-major cumsum order);
+  tokens over capacity are dropped. Gates: k == 1 uses the RAW router
+  probability (Switch semantics — renormalizing over a single kept
+  choice would make the gate identically 1.0 and starve the router of
+  task-loss gradient); k > 1 renormalizes over the kept subset.
+  """
+  b, t, e = probs.shape
+  onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)   # [B, T, K, E]
+  flat = onehot.transpose(0, 2, 1, 3).reshape(b, k * t, e)    # [B, KT, E]
+  position = jnp.cumsum(flat, axis=1) - flat
+  flat = flat * (position < capacity)
+  pos_onehot = flat[..., None] * jax.nn.one_hot(
+      position.astype(jnp.int32), capacity, dtype=jnp.float32)
+  dispatch = pos_onehot.reshape(b, k, t, e, capacity).sum(1)  # [B, T, E, C]
+  gate = dispatch.sum(-1) * probs                             # [B, T, E]
+  if k > 1:
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+  combine = gate[..., None] * dispatch
+  return dispatch, combine
+
+
 class MoEMlp(nn.Module):
   """Top-k routed expert MLP: [B, L, d] -> [B, L, d] (+ aux loss).
 
-  ``capacity_factor``: per-expert slots = ceil(k * L * factor / E),
-  rounded up to a multiple of 8 (sublane alignment). With
-  ``capacity_factor >= E / k`` no token can overflow (useful in tests).
-  Returns ``(out, aux_loss)``; aux_loss is the Switch load-balance term.
+  ``capacity_factor``: per-expert slots = ceil(k * T * factor / E),
+  rounded up to a multiple of 8 (sublane alignment), where T is the
+  routing GROUP size: the full L without expert parallelism, L/N per
+  shard with it (GShard grouped dispatch — each group routes and drops
+  independently). With ``capacity_factor >= E / k`` no token can
+  overflow in either regime, making the two paths numerically identical
+  (the parity tests' setting). Returns ``(out, aux_loss)``; aux_loss is
+  the Switch load-balance term computed over ALL tokens.
   """
 
   num_experts: int
@@ -60,6 +105,7 @@ class MoEMlp(nn.Module):
   def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     b, l, d = x.shape
     e, k = self.num_experts, min(self.top_k, self.num_experts)
+    ep_size = 1
     if self.ep_axis and self.mesh is not None:
       if self.ep_axis not in self.mesh.shape:
         raise ValueError(
@@ -71,56 +117,29 @@ class MoEMlp(nn.Module):
         raise ValueError(
             'expert parallelism needs num_experts ({}) divisible by the '
             '{!r} axis size ({}).'.format(e, self.ep_axis, ep_size))
-    capacity = int(np.ceil(k * l * self.capacity_factor / e))
-    capacity = max(8, -(-capacity // 8) * 8)
-    capacity = min(capacity, l)
+      if l % ep_size:
+        raise ValueError(
+            'expert parallelism routes tokens in L/N groups: the token '
+            'dim ({}) must be divisible by the {!r} axis size ({}).'
+            .format(l, self.ep_axis, ep_size))
 
-    # Router (f32): probs over experts per token.
+    # Router (f32): probs over experts per token. Replicated math — GSPMD
+    # shards it over whatever axes the activations carry.
     logits = nn.Dense(e, dtype=jnp.float32, name='router')(
         x.astype(jnp.float32))                              # [B, L, E]
     probs = jax.nn.softmax(logits, axis=-1)
-
-    # Top-k expert choice per token, then per-expert position assignment.
     _, expert_idx = jax.lax.top_k(probs, k)                 # [B, L, K]
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B, L, K, E]
-    # Position of each (token, choice) in its expert's buffer: the
-    # running count of earlier assignments to that expert (k-major so a
-    # token's secondary choice queues behind all primary choices of
-    # earlier tokens at the same expert only via the cumsum order below).
-    flat = onehot.transpose(0, 2, 1, 3).reshape(b, k * l, e)  # [B, KL, E]
-    position = jnp.cumsum(flat, axis=1) - flat              # [B, KL, E]
-    in_capacity = position < capacity
-    flat = flat * in_capacity
-    pos_onehot = flat[..., None] * jax.nn.one_hot(
-        position.astype(jnp.int32), capacity,
-        dtype=jnp.float32)                                  # [B, KL, E, C]
-    dispatch = pos_onehot.reshape(b, k, l, e, capacity).sum(1)  # [B,L,E,C]
 
-    # Gate values for surviving assignments, renormalized over kept k.
-    gate = (dispatch.sum(-1) * probs)                       # [B, L, E]
-    denom = jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
-    combine = (gate / denom)[..., None] * dispatch          # [B, L, E, C]
-
-    # Dispatch -> expert MLP -> combine, expert dim sharded over ep_axis.
     w_in = self.param('w_in', nn.initializers.lecun_normal(),
                       (e, d, self.expert_dim), jnp.float32)
     w_out = self.param('w_out', nn.initializers.lecun_normal(),
                        (e, self.expert_dim, d), jnp.float32)
-    ep = self.ep_axis
-    expert_in = jnp.einsum('blec,bld->ebcd', dispatch.astype(self.dtype),
-                           x.astype(self.dtype))            # [E, B, C, d]
-    from jax.sharding import PartitionSpec as P
-    if ep:
-      expert_in = constrain(expert_in, self.mesh, P(ep, None, None, None))
-    h = jnp.einsum('ebcd,edh->ebch', expert_in,
-                   w_in.astype(self.dtype))
-    h = nn.gelu(h)
-    expert_out = jnp.einsum('ebch,ehd->ebcd', h,
-                            w_out.astype(self.dtype))       # [E, B, C, d]
-    if ep:
-      expert_out = constrain(expert_out, self.mesh, P(ep, None, None, None))
-    out = jnp.einsum('blec,ebcd->bld', combine.astype(self.dtype),
-                     expert_out)
+
+    if ep_size > 1:
+      out = self._expert_parallel_apply(x, probs, expert_idx, w_in, w_out,
+                                        e, k, ep_size)
+    else:
+      out = self._dense_apply(x, probs, expert_idx, w_in, w_out, e, k)
 
     # Switch load-balance loss: E * sum_e fraction_tokens_e * mean_prob_e
     # (uses the pre-capacity primary assignments, the standard estimator).
@@ -129,3 +148,76 @@ class MoEMlp(nn.Module):
     mean_prob = probs.reshape(-1, e).mean(0)
     aux_loss = e * jnp.sum(fraction * mean_prob)
     return out.astype(x.dtype), aux_loss
+
+  def _dense_apply(self, x, probs, expert_idx, w_in, w_out, e, k):
+    """Single-group dispatch: the whole L routes against global capacity."""
+    capacity = _capacity(k, x.shape[1], self.capacity_factor, e)
+    dispatch, combine = _dispatch_combine(probs, expert_idx, e, k, capacity)
+    expert_in = jnp.einsum('blec,bld->ebcd', dispatch.astype(self.dtype),
+                           x.astype(self.dtype))            # [E, B, C, d]
+    h = nn.gelu(jnp.einsum('ebcd,edh->ebch', expert_in,
+                           w_in.astype(self.dtype)))
+    expert_out = jnp.einsum('ebch,ehd->ebcd', h,
+                            w_out.astype(self.dtype))       # [E, B, C, d]
+    return jnp.einsum('blec,ebcd->bld', combine.astype(self.dtype),
+                      expert_out)
+
+  def _expert_parallel_apply(self, x, probs, expert_idx, w_in, w_out,
+                             e, k, ep_size):
+    """GShard grouped dispatch in a shard_map: tokens split over the
+    expert axis into N groups that route locally; ``lax.all_to_all``
+    exchanges per-expert buffers so each shard computes its E/N resident
+    experts over ALL groups' tokens, and a second all-to-all routes the
+    results back (see module docstring for the measured byte comparison
+    against leaving this pattern to GSPMD)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tensor2robot_tpu.parallel.mesh import DATA_AXIS
+
+    ep = self.ep_axis
+    el = e // ep_size                                # local experts
+    b, l, d = x.shape
+    ls = l // ep_size                                # group (local) tokens
+    capacity = _capacity(k, ls, self.capacity_factor, e)
+    data_size = int(self.mesh.shape.get(DATA_AXIS, 1))
+    batch_axis = (DATA_AXIS
+                  if data_size > 1 and b % data_size == 0 else None)
+    dtype = self.dtype
+
+    def body(x_loc, probs_loc, idx_loc, w_in_loc, w_out_loc):
+      # x_loc [b', Ls, d]; w_in_loc [El, d, h].
+      dispatch, combine = _dispatch_combine(probs_loc, idx_loc, e, k,
+                                            capacity)
+      expert_in = jnp.einsum('blec,bld->ebcd', dispatch.astype(dtype),
+                             x_loc.astype(dtype))    # [E, b', C, d]
+      # Forward all-to-all: axis 0 (E = N*El, shard-contiguous expert
+      # blocks) splits into N messages; received blocks stack source-
+      # group-major -> [N, El, b', C, d] -> local experts over all groups.
+      recv = jax.lax.all_to_all(expert_in, ep, split_axis=0,
+                                concat_axis=0, tiled=True)
+      bp = recv.shape[1]
+      recv = recv.reshape(ep_size, el, bp, capacity, d)
+      recv = recv.transpose(1, 2, 0, 3, 4).reshape(el, bp,
+                                                   ep_size * capacity, d)
+      h = nn.gelu(jnp.einsum('ebcd,edh->ebch', recv,
+                             w_in_loc.astype(dtype)))
+      out = jnp.einsum('ebch,ehd->ebcd', h, w_out_loc.astype(dtype))
+      # Reverse all-to-all: regroup [El, b', N*C, d] by source group and
+      # send each group its tokens back; received blocks stack
+      # owner-shard-major, which IS global expert order (experts are
+      # shard-contiguous) -> [E, b', C, d].
+      out = out.reshape(el, bp, ep_size, capacity, d)
+      out = out.transpose(2, 0, 1, 3, 4).reshape(ep_size * el, bp,
+                                                 capacity, d)
+      out = jax.lax.all_to_all(out, ep, split_axis=0, concat_axis=0,
+                               tiled=True)           # [E, b', C, d]
+      return jnp.einsum('blec,ebcd->bld', combine.astype(dtype), out)
+
+    token_spec = P(batch_axis, ep, None)
+    fn = shard_map(
+        body, mesh=self.mesh,
+        in_specs=(token_spec, token_spec, token_spec,
+                  P(ep, None, None), P(ep, None, None)),
+        out_specs=token_spec, check_rep=False)
+    return fn(x, probs, expert_idx, w_in, w_out)

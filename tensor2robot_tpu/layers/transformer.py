@@ -125,7 +125,15 @@ class MultiHeadAttention(nn.Module):
     b, l, _ = x.shape
     features = self.num_heads * self.head_dim
     if self.tp_axis and self.mesh is not None:
-      tp_size = int(self.mesh.shape.get(self.tp_axis, 1))
+      if self.tp_axis not in self.mesh.shape:
+        # Mirror MoEMlp's ep_axis check: a missing axis would otherwise
+        # skip the divisibility check here and surface later as a cryptic
+        # with_sharding_constraint error.
+        raise ValueError(
+            'tp_axis {!r} is not an axis of the mesh (axes: {}); build the '
+            'mesh with a model axis (parallel.create_mesh).'.format(
+                self.tp_axis, tuple(self.mesh.axis_names)))
+      tp_size = int(self.mesh.shape[self.tp_axis])
       if self.num_heads % tp_size:
         # Catch at trace time: the param rule would otherwise shard the
         # flat qkv column dim mid-head (parallel/sharding.py matches on
@@ -147,6 +155,16 @@ class MultiHeadAttention(nn.Module):
     # run_attention would resolve it internally and the opaque
     # pallas_call would be all-gathered over the model axis.
     mode = resolve_attention_mode(self.attention_mode, l)
+    if self.tp_axis and mode == 'ring':
+      # Only the flash path is shard_mapped over tp; the ring path's
+      # seq-axis shard_map would force the head-sharded q/k/v to be
+      # all-gathered over the model axis, silently negating tensor
+      # parallelism for attention. Reject like the pipeline path does.
+      raise ValueError(
+          "tp_axis cannot combine with attention_mode='ring': the ring "
+          'shard_map replicates over the model axis, all-gathering the '
+          "head-sharded q/k/v. Use 'flash' (head-resident shard_map) or "
+          "'xla' with tensor parallelism, or drop tp_axis for ring.")
     if self.tp_axis and mode == 'flash':
       out = _flash_sharded_heads(q, k, v, causal=self.causal, mesh=self.mesh,
                                  tp_axis=self.tp_axis)
@@ -200,6 +218,7 @@ class TransformerBlock(nn.Module):
   tp_axis: Optional[str] = None
   moe_experts: int = 0           # > 0: MoE MLP instead of the dense MLP
   moe_top_k: int = 2
+  moe_capacity_factor: float = 1.25
   ep_axis: Optional[str] = None  # expert-parallel mesh axis for the MoE
   dropout_rate: float = 0.0
   dtype: jnp.dtype = jnp.float32
@@ -229,7 +248,8 @@ class TransformerBlock(nn.Module):
 
       h, aux = MoEMlp(
           num_experts=self.moe_experts, expert_dim=self.mlp_dim,
-          top_k=self.moe_top_k, mesh=self.mesh, ep_axis=self.ep_axis,
+          top_k=self.moe_top_k, capacity_factor=self.moe_capacity_factor,
+          mesh=self.mesh, ep_axis=self.ep_axis,
           dtype=self.dtype, name='moe')(h)
     else:
       h = nn.Dense(self.mlp_dim, dtype=self.dtype, name='mlp_in')(h)
@@ -337,6 +357,7 @@ class CausalTransformer(nn.Module):
   tp_axis: Optional[str] = None
   moe_experts: int = 0
   moe_top_k: int = 2
+  moe_capacity_factor: float = 1.25
   ep_axis: Optional[str] = None
   pipe_axis: Optional[str] = None
   pipeline_microbatches: int = 2
@@ -350,7 +371,8 @@ class CausalTransformer(nn.Module):
         mlp_dim=self.mlp_dim, attention_mode=self.attention_mode,
         causal=True, mesh=self.mesh, seq_axis=self.seq_axis,
         tp_axis=self.tp_axis, moe_experts=self.moe_experts,
-        moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+        moe_top_k=self.moe_top_k,
+        moe_capacity_factor=self.moe_capacity_factor, ep_axis=self.ep_axis,
         dropout_rate=self.dropout_rate, dtype=self.dtype, name=name)
 
   @nn.compact

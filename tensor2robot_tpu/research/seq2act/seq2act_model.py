@@ -135,6 +135,7 @@ class RT1StyleNet(nn.Module):
   tp_axis: Optional[str] = None
   moe_experts: int = 0
   moe_top_k: int = 2
+  moe_capacity_factor: float = 1.25
   ep_axis: Optional[str] = None
   pipe_axis: Optional[str] = None
   pipeline_microbatches: int = 2
@@ -188,7 +189,8 @@ class RT1StyleNet(nn.Module):
         max_length=self.max_episode_length * k,
         attention_mode=self.attention_mode, mesh=self.mesh,
         tp_axis=self.tp_axis, moe_experts=self.moe_experts,
-        moe_top_k=self.moe_top_k, ep_axis=self.ep_axis,
+        moe_top_k=self.moe_top_k,
+        moe_capacity_factor=self.moe_capacity_factor, ep_axis=self.ep_axis,
         pipe_axis=self.pipe_axis,
         pipeline_microbatches=self.pipeline_microbatches,
         pipeline_remat=self.pipeline_remat,
@@ -230,6 +232,7 @@ class Seq2ActBCModel(AbstractT2RModel):
                tp_axis: Optional[str] = None,
                moe_experts: int = 0,
                moe_top_k: int = 2,
+               moe_capacity_factor: float = 1.25,
                ep_axis: Optional[str] = None,
                moe_aux_weight: float = 0.01,
                pipe_axis: Optional[str] = None,
@@ -270,6 +273,7 @@ class Seq2ActBCModel(AbstractT2RModel):
     self._tp_axis = tp_axis
     self._moe_experts = moe_experts
     self._moe_top_k = moe_top_k
+    self._moe_capacity_factor = moe_capacity_factor
     self._ep_axis = ep_axis
     self._moe_aux_weight = moe_aux_weight
     self._pipe_axis = pipe_axis
@@ -323,6 +327,7 @@ class Seq2ActBCModel(AbstractT2RModel):
         tp_axis=self._tp_axis,
         moe_experts=self._moe_experts,
         moe_top_k=self._moe_top_k,
+        moe_capacity_factor=self._moe_capacity_factor,
         ep_axis=self._ep_axis,
         pipe_axis=self._pipe_axis,
         pipeline_microbatches=self._pipeline_microbatches,

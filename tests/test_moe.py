@@ -16,7 +16,10 @@ def _dense_oracle(variables, x, top_k):
   logits = x @ w_r + b_r
   probs = jax.nn.softmax(logits, axis=-1)
   topv, topi = jax.lax.top_k(probs, top_k)
-  gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+  if top_k == 1:
+    gates = topv  # Switch: raw router prob scales the expert output.
+  else:
+    gates = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
   out = jnp.zeros_like(x)
   for j in range(top_k):
     idx = topi[..., j]                         # [B, L]
@@ -54,6 +57,21 @@ class TestMoEMlp:
     ref = _dense_oracle(variables, x, top_k=1)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+  def test_top1_router_gets_task_gradient(self):
+    """Switch top-1: the gate is the raw router prob, so the router
+    kernel must receive gradient from the task loss alone (no aux)."""
+    layer, variables, x = self._init(k=1)
+
+    def task_loss(params):
+      out, _ = layer.apply({'params': params}, x)
+      return jnp.sum(out ** 2)
+
+    grads = jax.grad(task_loss)(variables['params'])
+    g_router = np.asarray(grads['router']['kernel'])
+    assert np.abs(g_router).max() > 0.0, (
+        'top-1 router kernel got zero task-loss gradient — gate '
+        'renormalization must not collapse to 1.0 at k=1')
 
   def test_overflow_drops_not_corrupts(self):
     """Tiny capacity: outputs are a mix of routed tokens and exact zeros
@@ -110,12 +128,16 @@ class TestExpertParallel:
     from tensor2robot_tpu.specs import SpecStruct
     from tensor2robot_tpu.trainer import Trainer
 
+    # capacity_factor = E/k: no token drops in EITHER routing regime, so
+    # the grouped EP dispatch must match the single-group DP dispatch
+    # exactly (layers/moe.py MoEMlp docstring).
     model = Seq2ActBCModel(
         episode_length=4, action_size=2, vocab_size=8, img_res=(32, 32),
         src_img_res=(36, 36), tokens_per_frame=4, embed_dim=32,
         num_layers=2, num_heads=4, head_dim=8, mlp_dim=32,
         tokenizer_widths=(8, 8, 8, 16), attention_mode='xla',
-        mesh=mesh, moe_experts=4, moe_top_k=2, ep_axis=ep_axis)
+        mesh=mesh, moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+        ep_axis=ep_axis)
     rng = np.random.RandomState(0)
     frames = rng.randint(0, 255, (8, 4, 36, 36, 3), dtype=np.uint8)
     actions = rng.rand(8, 4, 2).astype(np.float32) * 2 - 1
@@ -156,6 +178,52 @@ class TestExpertParallel:
 
     w_in = [s for path, s in shardings.items() if path.endswith("'w_in']")]
     assert w_in and all('expert' in str(s.spec) for s in w_in), shardings
+
+  def test_ep_layer_matches_dense_path(self):
+    """The shard_map all-to-all execution equals the single-group einsum
+    path on the same weights (capacity_factor = E/k: no drops)."""
+    from tensor2robot_tpu import parallel
+
+    mesh = parallel.create_mesh({'data': 2, 'expert': 4})
+    dense = MoEMlp(num_experts=8, expert_dim=32, top_k=2,
+                   capacity_factor=4.0)
+    ep = MoEMlp(num_experts=8, expert_dim=32, top_k=2, capacity_factor=4.0,
+                mesh=mesh, ep_axis='expert')
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 16, 16), jnp.float32)
+    variables = dense.init(jax.random.PRNGKey(0), x)
+    out_dense, aux_dense = dense.apply(variables, x)
+    out_ep, aux_ep = ep.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_ep), np.asarray(out_dense),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux_ep), float(aux_dense), rtol=1e-6)
+
+  def test_ep_lowers_to_all_to_all(self):
+    """The compiled EP program contains the forward+reverse all-to-all
+    pair — the GShard communication pattern the layer hand-codes
+    (VERDICT r4 item 2's EP collective assertion, at the layer level)."""
+    from tensor2robot_tpu import parallel
+    from tensor2robot_tpu.parallel.hlo_analysis import (
+        compiled_collective_stats,
+    )
+
+    mesh = parallel.create_mesh({'data': 2, 'expert': 4})
+    layer = MoEMlp(num_experts=8, expert_dim=32, top_k=2,
+                   capacity_factor=4.0, mesh=mesh, ep_axis='expert')
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16, 16), jnp.float32)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    fn = jax.jit(lambda v, x: layer.apply(v, x)[0])
+    stats = compiled_collective_stats(fn, variables, x)
+    assert stats.get('all-to-all', {}).get('count', 0) >= 2, stats
+
+  def test_ep_rejects_indivisible_token_dim(self):
+    from tensor2robot_tpu import parallel
+
+    mesh = parallel.create_mesh({'data': 2, 'expert': 4})
+    layer = MoEMlp(num_experts=8, expert_dim=8, mesh=mesh,
+                   ep_axis='expert')
+    with pytest.raises(ValueError, match='token dim'):
+      layer.init(jax.random.PRNGKey(0), jnp.zeros((2, 6, 16)))
 
 
 class TestMoEDtypes:
