@@ -1,0 +1,92 @@
+"""Collective-op accounting from compiled HLO: the scale-out evidence tool.
+
+The reference ships communication as opaque library calls (NCCL/MPI via
+TF's distributed runtime); what its graphs actually move per step is
+invisible without vendor profilers. Here the communication schedule IS
+the compiled program: GSPMD lowers sharding constraints to named HLO
+collectives, so the per-step communication volume can be read — and
+asserted — straight from the executable. Used by ``__graft_entry__``'s
+multichip dryrun (each parallelism family asserts the collectives its
+design predicts) and by ``docs/parallelism.md``'s pod-scale projection.
+
+Counting rules:
+  * Async pairs (``all-reduce-start``/``-done``) count ONCE, at start.
+  * Bytes are the op's RESULT payload (tuple elements summed): for
+    all-reduce that equals the reduced tensor size; for all-gather the
+    gathered (output) size; for all-to-all the shuffled size;
+    reduce-scatter the scattered (smaller) output. This is the
+    device-local traffic entering/leaving the op, the quantity an ICI
+    bandwidth model consumes; link-level traffic additionally depends on
+    the algorithm (ring all-reduce moves ~2x(N-1)/N of the payload).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+COLLECTIVE_KINDS = ('all-reduce', 'all-gather', 'all-to-all',
+                    'collective-permute', 'reduce-scatter')
+
+_DTYPE_BYTES = {
+    'pred': 1, 's8': 1, 'u8': 1, 'f8e4m3fn': 1, 'f8e5m2': 1,
+    's16': 2, 'u16': 2, 'f16': 2, 'bf16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8, 'c64': 8,
+    'c128': 16,
+}
+
+_SHAPE_RE = re.compile(r'([a-z]+[0-9a-z]*)\[([0-9,]*)\]')
+_OP_RE = re.compile(
+    r'=\s*(?P<shapes>[^=]*?)\s'
+    r'(?P<kind>all-reduce|all-gather|all-to-all|collective-permute|'
+    r'reduce-scatter)(?P<variant>-start)?\(')
+
+
+def _shape_bytes(shapes_str: str) -> int:
+  total = 0
+  for dtype, dims in _SHAPE_RE.findall(shapes_str):
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:
+      continue  # token[], opaque[] etc.
+    n = 1
+    for dim in dims.split(','):
+      if dim:
+        n *= int(dim)
+    total += n * size
+  return total
+
+
+def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
+  """{kind: {'count': n, 'bytes': result_payload_bytes}} from HLO text.
+
+  ``hlo_text``: ``jit(fn).lower(*args).compile().as_text()`` (post-SPMD —
+  the collectives only exist after partitioning, so analyze the COMPILED
+  module, not the lowered StableHLO).
+  """
+  stats = {kind: {'count': 0, 'bytes': 0} for kind in COLLECTIVE_KINDS}
+  for line in hlo_text.splitlines():
+    m = _OP_RE.search(line)
+    if not m:
+      continue
+    kind = m.group('kind')
+    stats[kind]['count'] += 1
+    stats[kind]['bytes'] += _shape_bytes(m.group('shapes'))
+  return {k: v for k, v in stats.items() if v['count']}
+
+
+def compiled_collective_stats(jitted_fn, *args, **kwargs):
+  """Convenience: lower+compile a jitted fn and analyze its collectives."""
+  compiled = jitted_fn.lower(*args, **kwargs).compile()
+  return collective_stats(compiled.as_text())
+
+
+def total_collective_bytes(stats: Dict[str, Dict[str, int]]) -> int:
+  return sum(v['bytes'] for v in stats.values())
+
+
+def format_stats(stats: Dict[str, Dict[str, int]]) -> str:
+  if not stats:
+    return 'no collectives'
+  return ', '.join('{}: {}x / {:.2f} MiB'.format(
+      kind, v['count'], v['bytes'] / 2**20) for kind, v in stats.items())
