@@ -20,6 +20,7 @@ watching the filesystem (SURVEY.md §2.9 'filesystem as transport').
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Any, Callable, Iterator, Optional, Sequence
@@ -29,6 +30,16 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 CHECKPOINT_SUBDIR = 'checkpoints'
+
+# Version of the in-checkpoint parameter LAYOUT (not the tree structure).
+# Layout changes are shape-compatible but numerically incompatible — a
+# silent restore would produce scrambled math — so the version is written
+# next to the checkpoints and verified on restore. History:
+#   2: transformer qkv columns head-major ([H, 3, Dh] groups, was
+#      q|k|v-major) and pipelined pipe_blocks leaves [S, k, ...] (was
+#      [L, ...]); layers/transformer.py round 4.
+PARAM_LAYOUT_VERSION = 2
+_FORMAT_FILENAME = 'format.json'
 
 
 class CheckpointManager:
@@ -40,7 +51,8 @@ class CheckpointManager:
                save_interval_steps: int = 1,
                async_checkpoints: bool = True,
                best_fn: Optional[Callable[[Any], float]] = None,
-               best_mode: str = 'min'):
+               best_mode: str = 'min',
+               assume_param_layout: Optional[int] = None):
     """Args mirror the reference's gin-exposed Saver/RunConfig knobs.
 
     Args:
@@ -52,7 +64,15 @@ class CheckpointManager:
         AsyncCheckpointSaverHook equivalent.
       best_fn: optional metrics -> scalar for best-checkpoint retention.
       best_mode: 'min' | 'max'.
+      assume_param_layout: the user's explicit assertion of the LAYOUT
+        version of pre-marker checkpoints in this directory (the marker
+        only exists from round 5 on, so an unmarked directory is
+        ambiguous between the current layout and older ones). Passing
+        the current ``PARAM_LAYOUT_VERSION`` stamps the marker and lets
+        the run resume; any other value (or None, the default) keeps
+        the loud failure.
     """
+    self._assume_param_layout = assume_param_layout
     self.directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
     options = ocp.CheckpointManagerOptions(
         max_to_keep=keep_checkpoint_max,
@@ -66,6 +86,7 @@ class CheckpointManager:
 
   def save(self, step: int, state, metrics: Optional[dict] = None,
            force: bool = False) -> bool:
+    self._write_format_marker()
     return self._manager.save(
         int(step), args=ocp.args.StandardSave(state), metrics=metrics,
         force=force)
@@ -81,8 +102,77 @@ class CheckpointManager:
     if step is None:
       raise FileNotFoundError(
           'No checkpoint found in {}.'.format(self.directory))
+    self._check_format_marker()
     return self._manager.restore(
         int(step), args=ocp.args.StandardRestore(state_template))
+
+  def _stamp_marker(self) -> None:
+    path = os.path.join(self.directory, _FORMAT_FILENAME)
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+      json.dump({'param_layout_version': PARAM_LAYOUT_VERSION}, f)
+    os.replace(tmp, path)
+
+  def _unmarked_steps(self):
+    if not os.path.isdir(self.directory):
+      return []
+    if os.path.exists(os.path.join(self.directory, _FORMAT_FILENAME)):
+      return []
+    return sorted(int(name) for name in os.listdir(self.directory)
+                  if name.isdigit())
+
+  def _write_format_marker(self) -> None:
+    path = os.path.join(self.directory, _FORMAT_FILENAME)
+    if os.path.exists(path):
+      return
+    # An UNMARKED directory with checkpoints is ambiguous: the marker
+    # only exists from round 5 on, so those steps may be the current
+    # layout (round-4 builds) or an older one. Stamping the current
+    # version over them would let a later restore of old-layout params
+    # pass silently — refuse unless the caller asserts the layout.
+    existing = self._unmarked_steps()
+    if existing and self._assume_param_layout != PARAM_LAYOUT_VERSION:
+      raise ValueError(
+          'Checkpoint dir {} holds pre-marker checkpoints (steps {}) of '
+          'UNKNOWN param layout. If they were written by a build with '
+          'layout version {} (head-major qkv, [S, k] pipe_blocks), pass '
+          'CheckpointManager(..., assume_param_layout={}) to stamp the '
+          'marker and resume; otherwise migrate or clear the directory.'
+          .format(self.directory, existing[:5], PARAM_LAYOUT_VERSION,
+                  PARAM_LAYOUT_VERSION))
+    self._stamp_marker()
+
+  def _check_format_marker(self) -> None:
+    """Fail loudly on checkpoints with an older/unknown parameter layout.
+
+    Shape-compatible layout changes (see PARAM_LAYOUT_VERSION) restore
+    without error but scramble the numerics; the marker turns that into
+    an actionable exception instead. ``assume_param_layout`` is the
+    explicit escape hatch for pre-marker directories whose layout the
+    user knows.
+    """
+    path = os.path.join(self.directory, _FORMAT_FILENAME)
+    if not os.path.exists(path):
+      if self._assume_param_layout == PARAM_LAYOUT_VERSION:
+        self._stamp_marker()
+        return
+      raise ValueError(
+          'Checkpoint dir {} has no {} marker: its param layout is '
+          'unknown (the marker exists from round 5 on). If these '
+          'checkpoints were written with layout version {} (head-major '
+          'qkv columns, [S, k] pipe_blocks), pass '
+          'CheckpointManager(..., assume_param_layout={}) to proceed; '
+          'older-layout checkpoints restore shape-compatibly but '
+          'numerically SCRAMBLED — re-train or migrate those.'
+          .format(self.directory, _FORMAT_FILENAME, PARAM_LAYOUT_VERSION,
+                  PARAM_LAYOUT_VERSION))
+    with open(path) as f:
+      version = json.load(f).get('param_layout_version')
+    if version != PARAM_LAYOUT_VERSION:
+      raise ValueError(
+          'Checkpoint dir {} has param-layout version {} but this build '
+          'expects {}; restoring would scramble parameters. Re-train or '
+          'migrate.'.format(self.directory, version, PARAM_LAYOUT_VERSION))
 
   def latest_step(self) -> Optional[int]:
     return self._manager.latest_step()

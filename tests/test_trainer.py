@@ -112,6 +112,43 @@ class TestTrainer:
     restored_params = jax.device_get(restored.params)
     jax.tree.map(np.testing.assert_allclose, expected, restored_params)
 
+  def test_restore_rejects_stale_param_layout(self, model_dir):
+    """A checkpoint with a pre-head-major layout marker (or none at all)
+    must fail loudly, not restore shape-compatibly scrambled params."""
+    import json
+
+    from tensor2robot_tpu.trainer import checkpointing
+
+    model, generator = _make()
+    trainer = Trainer(model, model_dir, save_checkpoints_steps=5,
+                      async_checkpoints=False)
+    trainer.train(generator, max_train_steps=5)
+    trainer.close()
+
+    marker = os.path.join(model_dir, checkpointing.CHECKPOINT_SUBDIR,
+                          checkpointing._FORMAT_FILENAME)
+    assert os.path.exists(marker)
+
+    manager = CheckpointManager(model_dir, async_checkpoints=False)
+    with open(marker, 'w') as f:
+      json.dump({'param_layout_version': 1}, f)
+    with pytest.raises(ValueError, match='param-layout version 1'):
+      manager.restore(None)
+    os.remove(marker)
+    with pytest.raises(ValueError, match='param layout is unknown'):
+      manager.restore(None)
+    manager.close()
+
+    # The explicit escape hatch: asserting the current layout stamps the
+    # marker and lets a pre-marker (round-4) run resume.
+    assuming = CheckpointManager(
+        model_dir, async_checkpoints=False,
+        assume_param_layout=checkpointing.PARAM_LAYOUT_VERSION)
+    restored = assuming.restore(None)
+    assert restored is not None
+    assert os.path.exists(marker)
+    assuming.close()
+
   def test_predict_parity_after_restore(self, model_dir):
     """Serving predictions match in-process predictions (ref :91-150)."""
     model, generator = _make(use_batch_norm=False)
