@@ -201,7 +201,12 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
                shuffle_buffer_size: int = 500,
                prefetch: int = 2,
                use_native: Union[bool, str] = 'auto',
-               num_native_threads: Optional[int] = None):
+               num_native_threads: Optional[int] = None,
+               sequence_max_len: Optional[int] = None):
+    """``sequence_max_len``: step capacity bound for SequenceExample
+    (is_sequence) specs on the native fast path — e.g. the workload's
+    episode-length bound. Without it sequence datasets read through the
+    Python parser (native_loader.plan_for_specs)."""
     super().__init__(batch_size=batch_size)
     if not file_patterns and not dataset_map:
       raise ValueError('file_patterns or dataset_map is required.')
@@ -213,6 +218,7 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     self._prefetch = prefetch
     self._use_native = use_native
     self._num_native_threads = num_native_threads
+    self._sequence_max_len = sequence_max_len
 
   def _dataset_files(self) -> Dict[str, str]:
     if self._dataset_map is not None:
@@ -275,13 +281,15 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
             'use_native=True but multi-dataset zip (dataset_map) is only '
             'supported by the Python pipeline.')
       return None  # multi-dataset zip stays on the Python path
-    plan = native_loader.plan_for_specs(self._feature_spec, self._label_spec)
+    plan = native_loader.plan_for_specs(
+        self._feature_spec, self._label_spec,
+        sequence_max_len=self._sequence_max_len)
     if plan is None:
       if self._use_native is True:
         raise ValueError(
             'use_native=True but the specs are not supported by the native '
-            'loader (sequences, varlen, optional, PNG, duplicate or unnamed '
-            'feature names).')
+            'loader (sequences without sequence_max_len, varlen, optional, '
+            'PNG, duplicate or unnamed feature names).')
       return None
     try:
       # Through _dataset_files() so subclass overrides (e.g. Fractional's

@@ -187,17 +187,23 @@ struct FieldSpec {
   FieldKind kind;
   int dtype_size;  // int fields: output width in bytes (1, 4, 8)
   int h = 0, w = 0, c = 0;  // image fields
-  // float/int fields: elements per row. image_full fields: number of
-  // frames (a rank-4 [T, H, W, C] spec stores T JPEGs as a bytes list;
-  // 0/1 means a single [H, W, C] image). image_coef_sparse fields: the
-  // per-row entry capacity of the sparse (delta, value) streams.
+  // float/int fields: elements per row (per STEP for sequence fields).
+  // image_full fields: number of frames (a rank-4 [T, H, W, C] spec
+  // stores T JPEGs as a bytes list; 0/1 means a single [H, W, C] image).
+  // image_coef_sparse fields: the per-row entry capacity of the sparse
+  // (delta, value) streams.
   long long count = 0;
+  // > 0: a SequenceExample feature_lists field (float/int only) with this
+  // step CAPACITY; rows are [seq_cap, count] with zero padding past the
+  // record's actual step count, which lands in buf_n.
+  long long seq_cap = 0;
   // Buffer indices into Slot::buffers (filled at config time).
   int buf0 = -1;            // primary (float/int/u8 pixels, coef Y, or
                             // sparse deltas)
   int buf_cb = -1, buf_cr = -1, buf_qt = -1;  // image_coef extras; sparse
                             // mode reuses buf_cb for values
-  int buf_n = -1;           // image_coef_sparse: per-row entry counts
+  int buf_n = -1;           // per-row counts: sparse entry counts, or
+                            // sequence step counts
 };
 
 struct Config {
@@ -209,6 +215,8 @@ struct Config {
   long long seed = -1;
   long long epochs = -1;  // -1: infinite
   bool verify_crc = false;
+  bool any_seq = false;   // any sequence field: records parse as
+                          // SequenceExample (context + feature_lists)
   std::vector<std::string> files;
   std::vector<FieldSpec> fields;
   std::vector<long long> buffer_sizes;  // per-slot bytes for each buffer
@@ -241,7 +249,7 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
         FieldSpec f;
         int kind, name_len;
         in >> name_len >> kind >> f.dtype_size >> f.h >> f.w >> f.c
-            >> f.count;
+            >> f.count >> f.seq_cap;
         f.kind = (FieldKind)kind;
         in.ignore(1);  // single separating space
         f.name.resize(name_len);
@@ -262,6 +270,19 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
   // Assign buffers. Layout mirrored in native_loader.py (_field_buffers).
   long long B = cfg->batch_size;
   for (auto& f : cfg->fields) {
+    if (f.seq_cap > 0) {
+      if (f.kind != kFloat && f.kind != kInt) {
+        *err = "sequence fields must be float/int: " + f.name;
+        return false;
+      }
+      cfg->any_seq = true;
+      int width = f.kind == kFloat ? 4 : f.dtype_size;
+      f.buf0 = (int)cfg->buffer_sizes.size();
+      cfg->buffer_sizes.push_back(B * f.seq_cap * f.count * width);
+      f.buf_n = (int)cfg->buffer_sizes.size();  // step counts, int32
+      cfg->buffer_sizes.push_back(B * 4);
+      continue;
+    }
     switch (f.kind) {
       case kFloat:
         f.buf0 = (int)cfg->buffer_sizes.size();
@@ -871,6 +892,40 @@ struct Loader {
 
   // ---- workers -----------------------------------------------------------
 
+  // Walks one map entry ({1: key-bytes, 2: value-message}) shared by the
+  // Features and FeatureLists sides. Returns the matched field index among
+  // fields whose (seq_cap > 0) equals ``sequence``, or -1; *value_out gets
+  // the value message cursor.
+  int match_entry(Cursor entry, bool sequence, Cursor* value_out) {
+    const uint8_t* key_p = nullptr;
+    size_t key_n = 0;
+    Cursor value{nullptr, nullptr};
+    uint32_t wt;
+    while (uint32_t f3 = entry.tag(&wt)) {
+      if (f3 == 1 && wt == 2) {
+        Cursor k = entry.bytes();
+        key_p = k.p;
+        key_n = k.size();
+      } else if (f3 == 2 && wt == 2) {
+        value = entry.bytes();
+      } else {
+        entry.skip(wt);
+      }
+    }
+    if (!key_p || !value.p) return -1;
+    // Linear scan: few fields, avoids hashing every record key.
+    for (size_t i = 0; i < cfg.fields.size(); i++) {
+      const FieldSpec& f = cfg.fields[i];
+      if ((f.seq_cap > 0) != sequence) continue;
+      if (f.name.size() == key_n &&
+          memcmp(f.name.data(), key_p, key_n) == 0) {
+        *value_out = value;
+        return (int)i;
+      }
+    }
+    return -1;
+  }
+
   std::string parse_into(const std::string& rec, int slot_idx, int row) {
     Slot& slot = slots[slot_idx];
     Cursor ex{(const uint8_t*)rec.data(),
@@ -879,47 +934,39 @@ struct Loader {
     std::vector<bool> found(cfg.fields.size(), false);
     uint32_t wt;
     while (uint32_t fnum = ex.tag(&wt)) {
-      if (fnum != 1 || wt != 2) {
+      if (fnum == 1 && wt == 2) {
+        // Example.features / SequenceExample.context (wire-identical).
+        Cursor features = ex.bytes();
+        while (uint32_t f2 = features.tag(&wt)) {
+          if (f2 != 1 || wt != 2) {
+            features.skip(wt);
+            continue;
+          }
+          Cursor value{nullptr, nullptr};
+          int fi = match_entry(features.bytes(), /*sequence=*/false, &value);
+          if (fi < 0) continue;
+          found[fi] = true;
+          std::string err = extract_field(cfg.fields[fi], value, slot, row);
+          if (!err.empty()) return err;
+        }
+      } else if (fnum == 2 && wt == 2 && cfg.any_seq) {
+        // SequenceExample.feature_lists = {1: entry {1: key, 2: FeatureList}}.
+        Cursor lists = ex.bytes();
+        while (uint32_t f2 = lists.tag(&wt)) {
+          if (f2 != 1 || wt != 2) {
+            lists.skip(wt);
+            continue;
+          }
+          Cursor value{nullptr, nullptr};
+          int fi = match_entry(lists.bytes(), /*sequence=*/true, &value);
+          if (fi < 0) continue;
+          found[fi] = true;
+          std::string err =
+              extract_sequence_field(cfg.fields[fi], value, slot, row);
+          if (!err.empty()) return err;
+        }
+      } else {
         ex.skip(wt);
-        continue;
-      }
-      Cursor features = ex.bytes();
-      while (uint32_t f2 = features.tag(&wt)) {
-        if (f2 != 1 || wt != 2) {
-          features.skip(wt);
-          continue;
-        }
-        Cursor entry = features.bytes();
-        // Map entry: key(1), value(2).
-        const uint8_t* key_p = nullptr;
-        size_t key_n = 0;
-        Cursor value{nullptr, nullptr};
-        while (uint32_t f3 = entry.tag(&wt)) {
-          if (f3 == 1 && wt == 2) {
-            Cursor k = entry.bytes();
-            key_p = k.p;
-            key_n = k.size();
-          } else if (f3 == 2 && wt == 2) {
-            value = entry.bytes();
-          } else {
-            entry.skip(wt);
-          }
-        }
-        if (!key_p || !value.p) continue;
-        // Match against configured fields (few fields; linear scan is fine
-        // and avoids hashing every record key).
-        int fi = -1;
-        for (size_t i = 0; i < cfg.fields.size(); i++) {
-          const std::string& nm = cfg.fields[i].name;
-          if (nm.size() == key_n && memcmp(nm.data(), key_p, key_n) == 0) {
-            fi = (int)i;
-            break;
-          }
-        }
-        if (fi < 0) continue;
-        found[fi] = true;
-        std::string err = extract_field(cfg.fields[fi], value, slot, row);
-        if (!err.empty()) return err;
       }
     }
     if (!ex.ok) return "malformed Example record";
@@ -1002,81 +1049,146 @@ struct Loader {
         case 2: {  // FloatList
           if (f.kind != kFloat)
             return "feature '" + f.name + "' is float but spec is not";
-          float* out = (float*)slot.buffers[f.buf0] + (long long)row * f.count;
-          long long got = 0;
-          uint32_t wt2;
-          // Packed encoding: field 1 wiretype 2 (bulk) or repeated wiretype 5.
-          while (uint32_t f2 = list.tag(&wt2)) {
-            if (f2 == 1 && wt2 == 2) {
-              Cursor packed = list.bytes();
-              long long n = packed.size() / 4;
-              if (got + n > f.count)
-                return "too many floats for '" + f.name + "'";
-              memcpy(out + got, packed.p, n * 4);
-              got += n;
-            } else if (f2 == 1 && wt2 == 5) {
-              if (got >= f.count)
-                return "too many floats for '" + f.name + "'";
-              if (list.end - list.p < 4)
-                return "truncated float in '" + f.name + "'";
-              memcpy(out + got, list.p, 4);
-              list.p += 4;
-              got++;
-            } else {
-              list.skip(wt2);
-            }
-          }
-          if (got != f.count) {
-            char buf[128];
-            snprintf(buf, sizeof buf, "feature '%s': got %lld floats, want "
-                     "%lld", f.name.c_str(), got, f.count);
-            return buf;
-          }
-          return "";
+          return parse_float_list(
+              f, list, (float*)slot.buffers[f.buf0] + (long long)row * f.count);
         }
         case 3: {  // Int64List
           if (f.kind != kInt)
             return "feature '" + f.name + "' is int64 but spec is not";
-          uint8_t* base = slot.buffers[f.buf0] +
-                          (long long)row * f.count * f.dtype_size;
-          long long got = 0;
-          uint32_t wt2;
-          auto store = [&](uint64_t v) {
-            switch (f.dtype_size) {
-              case 1: base[got] = (uint8_t)v; break;
-              case 4: ((int32_t*)base)[got] = (int32_t)v; break;
-              default: ((int64_t*)base)[got] = (int64_t)v; break;
-            }
-            got++;
-          };
-          while (uint32_t f2 = list.tag(&wt2)) {
-            if (f2 == 1 && wt2 == 2) {
-              Cursor packed = list.bytes();
-              while (packed.p < packed.end && got < f.count)
-                store(packed.varint());
-              if (packed.p < packed.end)
-                return "too many ints for '" + f.name + "'";
-            } else if (f2 == 1 && wt2 == 0) {
-              if (got >= f.count)
-                return "too many ints for '" + f.name + "'";
-              store(list.varint());
-            } else {
-              list.skip(wt2);
-            }
-          }
-          if (got != f.count) {
-            char buf[128];
-            snprintf(buf, sizeof buf, "feature '%s': got %lld ints, want "
-                     "%lld", f.name.c_str(), got, f.count);
-            return buf;
-          }
-          return "";
+          return parse_int_list(
+              f, list,
+              slot.buffers[f.buf0] + (long long)row * f.count * f.dtype_size);
         }
         default:
           value.skip(wt);
       }
     }
     return "feature '" + f.name + "' has no value list";
+  }
+
+  // FloatList message -> exactly f.count floats at ``out``.
+  std::string parse_float_list(const FieldSpec& f, Cursor list, float* out) {
+    long long got = 0;
+    uint32_t wt2;
+    // Packed encoding: field 1 wiretype 2 (bulk) or repeated wiretype 5.
+    while (uint32_t f2 = list.tag(&wt2)) {
+      if (f2 == 1 && wt2 == 2) {
+        Cursor packed = list.bytes();
+        long long n = packed.size() / 4;
+        if (got + n > f.count)
+          return "too many floats for '" + f.name + "'";
+        memcpy(out + got, packed.p, n * 4);
+        got += n;
+      } else if (f2 == 1 && wt2 == 5) {
+        if (got >= f.count)
+          return "too many floats for '" + f.name + "'";
+        if (list.end - list.p < 4)
+          return "truncated float in '" + f.name + "'";
+        memcpy(out + got, list.p, 4);
+        list.p += 4;
+        got++;
+      } else {
+        list.skip(wt2);
+      }
+    }
+    if (got != f.count) {
+      char buf[128];
+      snprintf(buf, sizeof buf, "feature '%s': got %lld floats, want %lld",
+               f.name.c_str(), got, f.count);
+      return buf;
+    }
+    return "";
+  }
+
+  // Int64List message -> exactly f.count ints at ``base``.
+  std::string parse_int_list(const FieldSpec& f, Cursor list, uint8_t* base) {
+    long long got = 0;
+    uint32_t wt2;
+    auto store = [&](uint64_t v) {
+      switch (f.dtype_size) {
+        case 1: base[got] = (uint8_t)v; break;
+        case 4: ((int32_t*)base)[got] = (int32_t)v; break;
+        default: ((int64_t*)base)[got] = (int64_t)v; break;
+      }
+      got++;
+    };
+    while (uint32_t f2 = list.tag(&wt2)) {
+      if (f2 == 1 && wt2 == 2) {
+        Cursor packed = list.bytes();
+        while (packed.p < packed.end && got < f.count)
+          store(packed.varint());
+        if (packed.p < packed.end)
+          return "too many ints for '" + f.name + "'";
+      } else if (f2 == 1 && wt2 == 0) {
+        if (got >= f.count)
+          return "too many ints for '" + f.name + "'";
+        store(list.varint());
+      } else {
+        list.skip(wt2);
+      }
+    }
+    if (got != f.count) {
+      char buf[128];
+      snprintf(buf, sizeof buf, "feature '%s': got %lld ints, want %lld",
+               f.name.c_str(), got, f.count);
+      return buf;
+    }
+    return "";
+  }
+
+  // One step Feature inside a FeatureList -> f.count elements at ``out``.
+  std::string extract_step(const FieldSpec& f, Cursor feature, uint8_t* out) {
+    uint32_t wt;
+    while (uint32_t fnum = feature.tag(&wt)) {
+      if (wt != 2) {
+        feature.skip(wt);
+        continue;
+      }
+      Cursor list = feature.bytes();
+      if (fnum == 2 && f.kind == kFloat)
+        return parse_float_list(f, list, (float*)out);
+      if (fnum == 3 && f.kind == kInt)
+        return parse_int_list(f, list, out);
+      if (fnum == 1)
+        return "sequence feature '" + f.name + "' has bytes steps (not "
+               "supported natively)";
+      return "sequence feature '" + f.name + "' step kind mismatch";
+    }
+    return "sequence feature '" + f.name + "' has an empty step";
+  }
+
+  // FeatureList message ({1: repeated Feature}) -> [seq_cap, count] row
+  // with zero padding past the record's step count (the Python parser's
+  // batch-pad semantics; pad value 0 — varlen defaults fall back).
+  std::string extract_sequence_field(const FieldSpec& f, Cursor fl,
+                                     Slot& slot, int row) {
+    int width = f.kind == kFloat ? 4 : f.dtype_size;
+    long long step_bytes = f.count * width;
+    uint8_t* base = slot.buffers[f.buf0] +
+                    (long long)row * f.seq_cap * step_bytes;
+    long long step = 0;
+    uint32_t wt;
+    while (uint32_t fnum = fl.tag(&wt)) {
+      if (fnum == 1 && wt == 2) {
+        if (step >= f.seq_cap) {
+          char buf[160];
+          snprintf(buf, sizeof buf, "sequence feature '%s': more than %lld "
+                   "steps (raise sequence_max_len)", f.name.c_str(),
+                   f.seq_cap);
+          return buf;
+        }
+        std::string err = extract_step(f, fl.bytes(),
+                                       base + step * step_bytes);
+        if (!err.empty()) return err;
+        step++;
+      } else {
+        fl.skip(wt);
+      }
+    }
+    ((int32_t*)slot.buffers[f.buf_n])[row] = (int32_t)step;
+    if (step < f.seq_cap)
+      memset(base + step * step_bytes, 0, (f.seq_cap - step) * step_bytes);
+    return "";
   }
 
   void worker_main() {

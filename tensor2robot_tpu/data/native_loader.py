@@ -105,14 +105,17 @@ class _Field:
 
   def __init__(self, key: str, spec: TensorSpec, kind: int,
                dtype_size: int, shape: Tuple[int, ...],
-               view_dtype, count: int = 0):
+               view_dtype, count: int = 0, seq_cap: int = 0):
     self.key = key            # flat spec key ('state/image')
     self.spec = spec
     self.kind = kind
     self.dtype_size = dtype_size
-    self.shape = shape        # per-row output shape
+    self.shape = shape        # per-row output shape (per STEP for seqs)
     self.view_dtype = view_dtype
     self.count = count
+    # > 0: SequenceExample feature_lists field with this step capacity;
+    # rows come back [seq_cap, *shape] zero-padded + a per-row length.
+    self.seq_cap = seq_cap
     # Images: last three dims are H, W, C (rank-4 specs carry a leading
     # frame count, which travels in ``count``).
     h, w, c = shape[-3:] if kind in (
@@ -122,9 +125,9 @@ class _Field:
 
   def config_line(self) -> str:
     name = self.spec.name.encode('utf-8')
-    return '{} {} {} {} {} {} {} {}'.format(
+    return '{} {} {} {} {} {} {} {} {}'.format(
         len(name), self.kind, self.dtype_size, self.h, self.w, self.c,
-        self.count, self.spec.name)
+        self.count, self.seq_cap, self.spec.name)
 
 
 class NativeLoaderPlan:
@@ -166,7 +169,9 @@ def sparse_capacity(spec: TensorSpec, density: float) -> int:
 
 def plan_for_specs(feature_spec, label_spec,
                    image_mode: str = 'full',
-                   sparse_density: float = 0.5) -> Optional[NativeLoaderPlan]:
+                   sparse_density: float = 0.5,
+                   sequence_max_len: Optional[int] = None
+                   ) -> Optional[NativeLoaderPlan]:
   """Returns a plan if the native fast path supports these specs, else None.
 
   ``image_mode``: 'full' (decode to uint8 pixels), 'coef' (entropy-only
@@ -180,6 +185,14 @@ def plan_for_specs(feature_spec, label_spec,
   fraction of the total coefficient count. Realistic camera frames run
   ~12-14% nonzero; the 0.5 default leaves 3-4x headroom (the stream
   errors with a clear message if a pathological image overflows it).
+
+  ``sequence_max_len``: step CAPACITY for SequenceExample feature_lists
+  specs (``is_sequence``), e.g. the workload's episode length bound.
+  Without it sequence specs fall back to the Python parser (the batch
+  buffers are preallocated, so an upper bound is required); records with
+  more steps fail with a clear error. Numeric (float/int) sequences only
+  — bytes/JPEG steps fall back; derived ``<key>_length`` specs are
+  produced by the stream, not read from disk.
   """
   feature_spec = specs_lib.flatten_spec_structure(feature_spec)
   label_spec = specs_lib.flatten_spec_structure(label_spec)
@@ -188,6 +201,11 @@ def plan_for_specs(feature_spec, label_spec,
   for side, struct in (('features', feature_spec), ('labels', label_spec)):
     for key in struct:
       spec = struct[key]
+      if (key.endswith('_length') and key[:-len('_length')] in struct
+          and struct[key[:-len('_length')]].is_sequence):
+        # Derived length spec (algebra.add_sequence_length_specs): the
+        # stream emits it from the parsed step counts.
+        continue
       if spec.name is None or spec.name in seen_names:
         # The Python parser supports unnamed specs (skipped) and the same
         # on-disk feature bound under several spec keys (fanned out at pack
@@ -195,7 +213,7 @@ def plan_for_specs(feature_spec, label_spec,
         # and validate_and_pack would then raise on the missing keys every
         # batch. Fall back rather than fail downstream.
         return None
-      if (spec.is_optional or spec.is_sequence
+      if (spec.is_optional
           or spec.varlen_default_value is not None
           or (spec.dataset_key or '')):
         return None
@@ -203,6 +221,25 @@ def plan_for_specs(feature_spec, label_spec,
       if any(s is None for s in shape):
         return None
       full_key = side + '/' + key
+      if spec.is_sequence:
+        if not sequence_max_len or spec.is_encoded_image:
+          return None
+        seen_names.add(spec.name)
+        count = int(np.prod(shape)) if shape else 1
+        if spec.dtype in (np.float32, bfloat16):
+          fields.append(_Field(full_key, spec, _KIND_FLOAT, 4, shape,
+                               np.float32, count,
+                               seq_cap=int(sequence_max_len)))
+        elif spec.dtype in (np.int64, np.int32, np.uint8, np.bool_):
+          size = {np.dtype(np.int64): 8, np.dtype(np.int32): 4,
+                  np.dtype(np.uint8): 1, np.dtype(np.bool_): 1}[
+                      np.dtype(spec.dtype)]
+          fields.append(_Field(full_key, spec, _KIND_INT, size, shape,
+                               spec.dtype, count,
+                               seq_cap=int(sequence_max_len)))
+        else:
+          return None
+        continue
       if spec.is_encoded_image:
         if spec.data_format not in (None, 'jpeg', 'JPEG', 'jpg'):
           return None
@@ -243,7 +280,12 @@ def plan_for_specs(feature_spec, label_spec,
       seen_names.add(spec.name)
   if not fields:
     return None
-  return NativeLoaderPlan(fields, feature_spec, label_spec)
+  # Sequence streams emit derived <key>_length tensors; the validation
+  # specs must include them (idempotent when the caller's spec already
+  # went through add_sequence_length_specs).
+  return NativeLoaderPlan(fields,
+                          specs_lib.add_sequence_length_specs(feature_spec),
+                          specs_lib.add_sequence_length_specs(label_spec))
 
 
 class NativeBatchedStream:
@@ -314,7 +356,9 @@ class NativeBatchedStream:
     """(field, sub) per buffer index — mirrors record_loader.cc's order."""
     layout = []
     for f in self._plan.fields:
-      if f.kind == _KIND_IMAGE_COEF:
+      if f.seq_cap > 0:
+        layout.extend([(f, ''), (f, 'len')])
+      elif f.kind == _KIND_IMAGE_COEF:
         layout.extend([(f, 'y'), (f, 'cb'), (f, 'cr'), (f, 'qt')])
       elif f.kind == _KIND_IMAGE_COEF_SPARSE:
         layout.extend([(f, 'sd'), (f, 'sv'), (f, 'qt'), (f, 'n')])
@@ -339,9 +383,15 @@ class NativeBatchedStream:
           if f.kind == _KIND_IMAGE_FULL:
             shape = (B,) + f.shape
             dtype = np.uint8
+          elif f.seq_cap > 0:
+            shape = (B, f.seq_cap) + f.shape
+            dtype = f.view_dtype
           else:
             shape = (B,) + f.shape
             dtype = f.view_dtype
+        elif sub == 'len':
+          shape = (B,)
+          dtype = np.int32
         elif sub == 'y':
           shape = (B, f.h // 8, f.w // 8, 64)
           dtype = np.int16
@@ -390,20 +440,36 @@ class NativeBatchedStream:
         buckets[f.key] = max(
             SPARSE_BUCKET,
             -(-max_n // SPARSE_BUCKET) * SPARSE_BUCKET)
+    # Sequence fields: slice the capacity-padded step dim to the batch's
+    # max actual length — the Python parser's pad-to-longest-in-batch
+    # semantics (parser.py parse_batch).
+    seq_max: Dict[str, int] = {}
+    seq_lengths: Dict[str, np.ndarray] = {}
+    for buf, (f, sub) in enumerate(layout):
+      if sub == 'len':
+        lengths = self._views[slot][buf]
+        seq_lengths[f.key] = lengths.astype(np.int64)
+        seq_max[f.key] = max(1, int(lengths.max()))
     by_key: Dict[str, np.ndarray] = {}
     for buf, (f, sub) in enumerate(layout):
       arr = self._views[slot][buf]
+      if sub == 'len':
+        continue  # emitted as <key>_length below
       if sub in ('sd', 'sv'):
         # .copy(), NOT ascontiguousarray: when the bucket equals the full
         # capacity the slice is already contiguous and ascontiguousarray
         # would return a live VIEW into the recycled ring buffer.
         arr = arr[:, :buckets[f.key]].copy()
+      elif f.seq_cap > 0 and sub == '':
+        arr = arr[:, :seq_max[f.key]].copy()
       elif self._copy:
         arr = arr.copy()
       key = f.key if not sub else f.key + '/' + sub
       if sub == '' and f.spec.dtype == bfloat16:
         arr = arr.astype(bfloat16)
       by_key[key] = arr
+      if f.seq_cap > 0 and sub == '':
+        by_key[f.key + '_length'] = seq_lengths[f.key]
     features = SpecStruct()
     labels = SpecStruct()
     for key, arr in by_key.items():

@@ -65,10 +65,13 @@ class TestPlan:
     features, labels = _specs()
     assert native_loader.plan_for_specs(features, labels) is not None
 
-  def test_sequence_ineligible(self):
+  def test_sequence_ineligible_without_max_len(self):
     features, labels = _specs()
     features.seq = TensorSpec((4,), np.float32, name='seq', is_sequence=True)
     assert native_loader.plan_for_specs(features, labels) is None
+    # With a step capacity the fast path takes sequence specs.
+    assert native_loader.plan_for_specs(features, labels,
+                                        sequence_max_len=8) is not None
 
   def test_optional_ineligible(self):
     features, labels = _specs()
@@ -250,6 +253,113 @@ class TestNativeStream:
     finally:
       stream.close()
     assert np.asarray(feats['x']).dtype == bfloat16
+
+
+def _sequence_specs():
+  """Metareacher-style episode specs (episode_to_transitions.py:63)."""
+  features = SpecStruct(
+      obs=TensorSpec((2,), np.float32, name='pose_t', is_sequence=True),
+      act=TensorSpec((3,), np.float32, name='action', is_sequence=True),
+      done=TensorSpec((1,), np.int64, name='done', is_sequence=True),
+      is_demo=TensorSpec((1,), np.int64, name='is_demo'))
+  labels = SpecStruct(
+      reward=TensorSpec((1,), np.float32, name='reward', is_sequence=True))
+  return features, labels
+
+
+def _write_sequence_records(path, n, max_steps=6, seed=0):
+  from tensor2robot_tpu.data.wire import build_sequence_example
+
+  rng = np.random.RandomState(seed)
+  records = []
+  for i in range(n):
+    t = int(rng.randint(2, max_steps + 1))
+    context = {'is_demo': np.asarray([i % 2], np.int64)}
+    lists = {
+        'pose_t': [rng.randn(2).astype(np.float32) for _ in range(t)],
+        'action': [rng.randn(3).astype(np.float32) for _ in range(t)],
+        'done': [np.asarray([int(s == t - 1)], np.int64) for s in range(t)],
+        'reward': [np.asarray([rng.rand()], np.float32) for _ in range(t)],
+    }
+    records.append(build_sequence_example(context, lists))
+  tfrecord.write_records(path, records)
+
+
+class TestSequenceRecords:
+  """SequenceExample fast path (VERDICT r4 item 5): wire parity with the
+  Python parser on feature_lists records — batch-max padding, int64
+  <key>_length outputs, context features, strict capacity."""
+
+  def test_matches_python_parser(self, tmp_path):
+    from tensor2robot_tpu.data.pipeline import (
+        BatchedExampleStream,
+        RecordDataset,
+    )
+
+    path = str(tmp_path / 'seq.tfrecord')
+    _write_sequence_records(path, 8)
+    features, labels = _sequence_specs()
+    plan = native_loader.plan_for_specs(
+        specs_lib.add_sequence_length_specs(features), labels,
+        sequence_max_len=8)
+    assert plan is not None
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=4, shuffle=False, num_epochs=1)
+    native_batches = list(iter(stream))
+    stream.close()
+    py_batches = list(iter(BatchedExampleStream(
+        RecordDataset(path), ExampleParser(features, labels),
+        batch_size=4, shuffle=False, num_epochs=1)))
+    assert len(native_batches) == len(py_batches) == 2
+    for (nf, nl), (pf, pl) in zip(native_batches, py_batches):
+      for key in pf:
+        np.testing.assert_array_equal(np.asarray(nf[key]),
+                                      np.asarray(pf[key]), err_msg=key)
+        assert nf[key].dtype == pf[key].dtype, key
+      for key in pl:
+        np.testing.assert_array_equal(np.asarray(nl[key]),
+                                      np.asarray(pl[key]), err_msg=key)
+
+  def test_over_capacity_raises(self, tmp_path):
+    path = str(tmp_path / 'seq.tfrecord')
+    _write_sequence_records(path, 4, max_steps=6)
+    features, labels = _sequence_specs()
+    plan = native_loader.plan_for_specs(features, labels,
+                                        sequence_max_len=3)
+    stream = native_loader.NativeBatchedStream(
+        plan, [path], batch_size=4, shuffle=False, num_epochs=1)
+    with pytest.raises(RuntimeError, match='sequence_max_len'):
+      list(iter(stream))
+    stream.close()
+
+  def test_generator_takes_native_path(self, tmp_path):
+    """DefaultRecordInputGenerator(sequence_max_len=...) routes sequence
+    datasets through the native loader (use_native=True would raise on
+    fallback, so success proves the fast path)."""
+    from tensor2robot_tpu.models.abstract_model import AbstractT2RModel
+
+    path = str(tmp_path / 'seq.tfrecord')
+    _write_sequence_records(path, 8)
+    features, labels = _sequence_specs()
+
+    class _Model(AbstractT2RModel):
+
+      def get_feature_specification(self, mode):
+        return features
+
+      def get_label_specification(self, mode):
+        return labels
+
+    generator = DefaultRecordInputGenerator(
+        file_patterns=path, batch_size=4, use_native=True,
+        sequence_max_len=8)
+    generator.set_specification_from_model(_Model(), ModeKeys.TRAIN)
+    it = generator.create_dataset_iterator(mode=ModeKeys.EVAL, num_epochs=1)
+    batch_features, batch_labels = next(it)
+    assert batch_features['obs'].shape[0] == 4
+    assert batch_features['obs'].shape[-1] == 2
+    assert batch_features['obs_length'].dtype == np.int64
+    assert batch_labels['reward'].shape[:2] == batch_features['obs'].shape[:2]
 
 
 class TestSoak:
