@@ -7,9 +7,13 @@ from tensor2robot_tpu.predictors.checkpoint_predictor import (
 from tensor2robot_tpu.predictors.exported_model_predictor import (
     ExportedModelPredictor,
 )
+from tensor2robot_tpu.predictors.exported_savedmodel_predictor import (
+    ExportedSavedModelPredictor,
+)
 
 __all__ = [
     'AbstractPredictor',
     'CheckpointPredictor',
     'ExportedModelPredictor',
+    'ExportedSavedModelPredictor',
 ]

@@ -182,3 +182,7 @@ class ExportedModelPredictor(AbstractPredictor):
   def close(self) -> None:
     self._variables = None
     self._exported_fn = None
+    # Reset version tracking: a closed predictor must not short-circuit a
+    # later restore() into "current version still newest and valid" while
+    # holding no loaded state.
+    self._version = None
