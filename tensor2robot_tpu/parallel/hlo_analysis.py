@@ -70,8 +70,14 @@ def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
     if not m:
       continue
     kind = m.group('kind')
+    nbytes = _shape_bytes(m.group('shapes'))
+    if m.group('variant'):
+      # Async `-start` ops return an (operands..., results...) tuple —
+      # symmetric halves — where the sync lowering returns only the
+      # result; halve so the payload is lowering-invariant.
+      nbytes //= 2
     stats[kind]['count'] += 1
-    stats[kind]['bytes'] += _shape_bytes(m.group('shapes'))
+    stats[kind]['bytes'] += nbytes
   return {k: v for k, v in stats.items() if v['count']}
 
 

@@ -1,0 +1,92 @@
+"""parallel/hlo_analysis.py: collective counting from compiled HLO.
+
+The dryrun's per-family collective assertions and docs/parallelism.md's
+byte accounting stand on this parser; these tests pin its behavior on
+programs whose collectives are known by construction.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensor2robot_tpu.parallel.hlo_analysis import (
+    collective_stats,
+    compiled_collective_stats,
+    format_stats,
+    total_collective_bytes,
+)
+
+
+def _mesh():
+  return Mesh(np.array(jax.devices()).reshape(8), ('data',))
+
+
+class TestCollectiveStats:
+
+  def test_psum_is_one_all_reduce_with_result_bytes(self):
+    mesh = _mesh()
+    fn = jax.jit(shard_map(lambda x: jax.lax.psum(x, 'data'), mesh=mesh,
+                           in_specs=P('data'), out_specs=P()))
+    x = jnp.ones((8, 128), jnp.float32)
+    stats = compiled_collective_stats(fn, x)
+    assert stats['all-reduce']['count'] == 1
+    # Result payload: the per-device [1, 128] f32 shard.
+    assert stats['all-reduce']['bytes'] == 128 * 4
+    assert 'all-gather' not in stats
+
+  def test_ppermute_is_collective_permute(self):
+    mesh = _mesh()
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.ppermute(x, 'data', perm), mesh=mesh,
+        in_specs=P('data'), out_specs=P('data')))
+    stats = compiled_collective_stats(fn, jnp.ones((8, 64), jnp.float32))
+    assert stats['collective-permute']['count'] >= 1
+
+  def test_all_gather_and_total_bytes(self):
+    mesh = _mesh()
+    fn = jax.jit(shard_map(lambda x: jax.lax.all_gather(x, 'data'),
+                           mesh=mesh, in_specs=P('data'),
+                           out_specs=P('data')))
+    stats = compiled_collective_stats(fn, jnp.ones((8, 32), jnp.float32))
+    assert stats['all-gather']['count'] == 1
+    assert total_collective_bytes(stats) == stats['all-gather']['bytes']
+
+  def test_all_to_all_detected(self):
+    mesh = _mesh()
+    fn = jax.jit(shard_map(
+        lambda x: jax.lax.all_to_all(x, 'data', split_axis=0,
+                                     concat_axis=0, tiled=True),
+        mesh=mesh, in_specs=P(None, 'data'), out_specs=P('data', None),
+        check_rep=False))
+    stats = compiled_collective_stats(
+        fn, jnp.ones((8, 8, 16), jnp.float32))
+    assert stats.get('all-to-all', {}).get('count', 0) >= 1
+
+  def test_no_collectives_on_single_device_program(self):
+    fn = jax.jit(lambda x: x * 2 + 1)
+    stats = compiled_collective_stats(fn, jnp.ones((4, 4)))
+    assert stats == {}
+    assert format_stats(stats) == 'no collectives'
+
+  def test_async_start_done_counted_once_and_dtype_sizes(self):
+    # Synthetic HLO lines: a start/done pair must count ONCE with the
+    # same payload as the sync lowering (the start result is a
+    # symmetric (operands, results) tuple — halved), bf16 is 2 bytes.
+    text = '\n'.join([
+        '%ar-s = (bf16[4,128]{1,0}, bf16[4,128]{1,0}) '
+        'all-reduce-start(bf16[4,128]{1,0} %p0), replica_groups={}',
+        '%ar-d = bf16[4,128]{1,0} all-reduce-done((bf16[4,128]{1,0}, '
+        'bf16[4,128]{1,0}) %ar-s)',
+        '%rs = f32[2,64]{1,0} reduce-scatter(f32[4,64]{1,0} %p1), '
+        'dimensions={0}',
+    ])
+    stats = collective_stats(text)
+    assert stats['all-reduce']['count'] == 1
+    assert stats['all-reduce']['bytes'] == 4 * 128 * 2
+    assert stats['reduce-scatter']['count'] == 1
+    assert stats['reduce-scatter']['bytes'] == 2 * 64 * 4
