@@ -1099,6 +1099,13 @@ def _bench_maml_vision_step(mesh):
       VRGripperRegressionModel,
   )
 
+  import jax
+
+  # Drop every earlier bench's resident executables first: the vmapped
+  # grad-through-grad conv towers are memory-hungry, and measured in the
+  # full bench sequence this field OOMs against leftover executables
+  # while succeeding standalone.
+  jax.clear_caches()
   maml = VRGripperEnvRegressionModelMAML(
       base_model=VRGripperRegressionModel(episode_length=8),
       inner_loop=MAMLInnerLoopGradientDescent(learning_rate=0.01))
@@ -1306,8 +1313,9 @@ def main():
     mv_ms, mv_spread = _bench_maml_vision_step(mesh)
     out['maml_vision_train_step_ms'] = round(mv_ms, 3)
     out['maml_vision_train_step_ms_spread'] = round(mv_spread, 3)
-  except Exception:  # noqa: BLE001
+  except Exception as e:  # noqa: BLE001
     out['maml_vision_train_step_ms'] = -1.0
+    out['maml_vision_error'] = repr(e)[:160]
 
   print(json.dumps(out))
 

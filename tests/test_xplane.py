@@ -1,0 +1,120 @@
+"""utils/xplane.py: protobuf-free xplane decoding + per-op aggregation."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu.utils import xplane
+
+
+def _varint(value: int) -> bytes:
+  out = bytearray()
+  while True:
+    byte = value & 0x7F
+    value >>= 7
+    if value:
+      out.append(byte | 0x80)
+    else:
+      out.append(byte)
+      return bytes(out)
+
+
+def _field(number: int, wire_type: int, payload: bytes) -> bytes:
+  return _varint((number << 3) | wire_type) + payload
+
+
+def _ld(number: int, payload: bytes) -> bytes:
+  return _field(number, 2, _varint(len(payload)) + payload)
+
+
+def _event(metadata_id: int, duration_ps: int) -> bytes:
+  return (_field(1, 0, _varint(metadata_id)) +
+          _field(3, 0, _varint(duration_ps)))
+
+
+def _synthetic_xspace(planes=('/device:TPU:0',)) -> bytes:
+  """TPU plane(s): 'XLA Ops' line with two ops, one of them twice."""
+  meta = {7: '%convert_reduce_fusion.3 = f32[2]{0} fusion(...)',
+          9: '%copy.1 = f32[2]{0} copy(...)'}
+  meta_entries = b''.join(
+      _ld(4, _field(1, 0, _varint(key)) +
+          _ld(2, _ld(2, name.encode())))
+      for key, name in meta.items())
+  line = (_ld(2, b'XLA Ops') +
+          _ld(4, _event(7, 3_000_000)) +      # 0.003 ms
+          _ld(4, _event(7, 1_000_000)) +
+          _ld(4, _event(9, 2_000_000)))
+  return b''.join(
+      _ld(1, _ld(2, name.encode()) + _ld(3, line) + meta_entries)
+      for name in planes)
+
+
+class TestSyntheticDecode:
+
+  def test_parse_and_aggregate(self, tmp_path):
+    path = str(tmp_path / 'test.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(_synthetic_xspace())
+    planes = xplane.parse_xspace(path)
+    assert [p[0] for p in planes] == ['/device:TPU:0']
+    totals = xplane.op_totals(path)
+    assert len(totals) == 2
+    key = [k for k in totals if 'convert_reduce' in k][0]
+    np.testing.assert_allclose(totals[key], 0.004)  # 3 + 1 µs in ms
+    fams = dict(xplane.op_families(path))
+    np.testing.assert_allclose(fams['%convert_reduce_fusion'], 0.004)
+    np.testing.assert_allclose(fams['%copy'], 0.002)
+
+  def test_n_steps_normalization(self, tmp_path):
+    path = str(tmp_path / 'test.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(_synthetic_xspace())
+    full = xplane.op_totals(path, n_steps=1)
+    halved = xplane.op_totals(path, n_steps=2)
+    for key in full:
+      np.testing.assert_allclose(halved[key], full[key] / 2)
+
+  def test_multi_chip_capture_is_ambiguous(self, tmp_path):
+    """Multiple matching planes (one per chip) must raise, not sum into
+    chip_count x ms/step; narrowing to one device resolves it."""
+    import pytest
+
+    path = str(tmp_path / 'test.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(_synthetic_xspace(planes=('/device:TPU:0', '/device:TPU:1')))
+    with pytest.raises(ValueError, match='matches 2 planes'):
+      xplane.op_totals(path)
+    totals = xplane.op_totals(path, plane_substr='/device:TPU:1')
+    assert len(totals) == 2
+
+  def test_truncated_capture_raises(self, tmp_path):
+    path = str(tmp_path / 'test.xplane.pb')
+    payload = _synthetic_xspace()
+    with open(path, 'wb') as f:
+      f.write(payload[:len(payload) // 2])
+    import pytest
+    with pytest.raises((ValueError, IndexError)):
+      xplane.parse_xspace(path)
+
+
+class TestRealTrace:
+
+  def test_cpu_profile_parses(self, tmp_path):
+    """A real jax.profiler capture decodes without error (CPU backend:
+    the TPU plane is absent, so op_totals is empty but parsing holds)."""
+    logdir = str(tmp_path / 'prof')
+    fn = jax.jit(lambda x: jnp.sin(x) @ x.T)
+    x = jnp.ones((64, 64))
+    fn(x).block_until_ready()
+    jax.profiler.start_trace(logdir)
+    fn(x).block_until_ready()
+    jax.profiler.stop_trace()
+    paths = glob.glob(os.path.join(logdir, '**', '*.xplane.pb'),
+                      recursive=True)
+    assert paths, 'profiler wrote no xplane'
+    planes = xplane.parse_xspace(paths[0])
+    assert planes and all(isinstance(p[0], str) for p in planes)
+    assert xplane.op_totals(paths[0], plane_substr='TPU') == {}
