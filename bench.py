@@ -986,7 +986,12 @@ def _bench_cem_latency(model, mesh):
   rng = np.random.RandomState(0)
   obs = {'image': rng.randint(0, 255, (512, 640, 3), dtype=np.uint8),
          'gripper_closed': 0.0, 'height_to_bottom': 0.1}
-  n = 10
+  # 25 chained selects ≈ 125 ms of device work per dispatch (5 ms/action
+  # measured): the tunnel's tens-of-ms round-trip variance amortizes to
+  # ~1 ms/action. Round-5 sessions recorded ±5 ms spreads at n=10 (vs
+  # ±0.8 in quieter ones) — method noise, not device noise; n=25
+  # measured 5.0 ± 0.4 ms.
+  n = 25
 
   @jax.jit
   def chained(variables, obs, key):
