@@ -74,6 +74,16 @@ def register_all() -> None:
   register(td3.TD3Hooks, 'TD3Hooks')
   register(variable_logger_hook.VariableLoggerHook, 'VariableLoggerHook')
 
+  # Reliability layer (docs/reliability.md): arm deterministic faults and
+  # tune retry backoff from a config file alone.
+  from tensor2robot_tpu.reliability import fault_injection
+  # reliability/__init__ rebinds the name 'retry' to the function (same
+  # shadowing as rl.run_env above); import the class from its module.
+  from tensor2robot_tpu.reliability.retry import RetryPolicy
+  register(fault_injection.configure_fault_injector,
+           'configure_fault_injector')
+  register(RetryPolicy, 'RetryPolicy')
+
   # Input generators (ref input_generators/default_input_generator.py).
   register(input_generators.DefaultRecordInputGenerator,
            'DefaultRecordInputGenerator')

@@ -202,16 +202,31 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
                prefetch: int = 2,
                use_native: Union[bool, str] = 'auto',
                num_native_threads: Optional[int] = None,
-               sequence_max_len: Optional[int] = None):
+               sequence_max_len: Optional[int] = None,
+               skip_corrupt_records: bool = False,
+               max_corrupt_records: int = 100,
+               max_corrupt_records_per_file: int = 10):
     """``sequence_max_len``: step capacity bound for SequenceExample
     (is_sequence) specs on the native fast path — e.g. the workload's
     episode-length bound. Without it sequence datasets read through the
-    Python parser (native_loader.plan_for_specs)."""
+    Python parser (native_loader.plan_for_specs).
+
+    ``skip_corrupt_records``: quarantine corrupt/truncated records instead
+    of raising, up to ``max_corrupt_records`` across the run and
+    ``max_corrupt_records_per_file`` in any one file; exhausting either
+    budget raises CorruptionBudgetExceeded naming the offending file
+    (docs/reliability.md). Counters surface in train metrics. Only the
+    Python pipeline can skip, so this disables the native fast path.
+    """
     super().__init__(batch_size=batch_size)
     if not file_patterns and not dataset_map:
       raise ValueError('file_patterns or dataset_map is required.')
     if file_patterns and dataset_map:
       raise ValueError('file_patterns and dataset_map are mutually exclusive.')
+    if skip_corrupt_records and use_native is True:
+      raise ValueError(
+          'use_native=True is incompatible with skip_corrupt_records: '
+          'only the Python pipeline can quarantine corrupt records.')
     self._file_patterns = file_patterns
     self._dataset_map = dataset_map
     self._shuffle_buffer_size = shuffle_buffer_size
@@ -219,6 +234,18 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     self._use_native = use_native
     self._num_native_threads = num_native_threads
     self._sequence_max_len = sequence_max_len
+    self._skip_corrupt_records = skip_corrupt_records
+    self._quarantine = None
+    if skip_corrupt_records:
+      from tensor2robot_tpu.reliability.quarantine import RecordQuarantine
+      self._quarantine = RecordQuarantine(
+          max_corrupt_records=max_corrupt_records,
+          max_corrupt_records_per_file=max_corrupt_records_per_file)
+
+  @property
+  def quarantine(self):
+    """The RecordQuarantine counting this generator's skips (or None)."""
+    return self._quarantine
 
   def _dataset_files(self) -> Dict[str, str]:
     if self._dataset_map is not None:
@@ -229,7 +256,17 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     """Returns a native-loader batch iterator, or None to fall back."""
     from tensor2robot_tpu.data import native_loader
 
+    if self._skip_corrupt_records and self._raw_feature_spec is None:
+      # Corrupt-record quarantine only exists in the Python reader; the
+      # native loader hard-fails on bad CRCs. (use_native=True was
+      # already rejected in __init__; device-decode streams have no
+      # Python fallback, so they cannot combine with skip mode either.)
+      return None
     if self._raw_feature_spec is not None:
+      if self._skip_corrupt_records:
+        raise ValueError(
+            'skip_corrupt_records is not supported with a '
+            'DeviceDecodePreprocessor (native-only stream).')
       # Device-decode wrapper in play: plan against the on-disk JPEG specs
       # in coef mode; the stream's key/{y,cb,cr,qt} outputs match the
       # wrapper's in-specs. No Python fallback exists for coef shipping —
@@ -318,7 +355,9 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
     parser = ExampleParser(self._feature_spec, self._label_spec)
     datasets = {
         key: RecordDataset(patterns, dataset_key=key,
-                           shard_index=shard_index, num_shards=num_shards)
+                           shard_index=shard_index, num_shards=num_shards,
+                           skip_corrupt_records=self._skip_corrupt_records,
+                           quarantine=self._quarantine)
         for key, patterns in self._dataset_files().items()
     }
     missing = set(parser.dataset_keys) - set(datasets)

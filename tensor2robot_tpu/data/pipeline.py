@@ -52,19 +52,28 @@ def parse_file_patterns(file_patterns: Union[str, Sequence[str]]):
 
 def _interleaved_records(filenames: List[str], cycle_length: int = 4,
                          shuffle_files: bool = False,
-                         seed: Optional[int] = None) -> Iterator[bytes]:
+                         seed: Optional[int] = None,
+                         skip_corrupt: bool = False,
+                         quarantine=None) -> Iterator[bytes]:
   """Round-robin interleave of records across shards (ref :548-558)."""
   files = list(filenames)
   if shuffle_files:
     random.Random(seed).shuffle(files)
+
+  def _reader(path):
+    # CRC verification is cheap (C impl) and turns silent shard corruption
+    # into a clear 'Corrupt TFRecord' error instead of misframed garbage;
+    # skip_corrupt downgrades that error to a budgeted quarantine skip.
+    return tfrecord.tfrecord_iterator(path, verify_crc=True,
+                                      skip_corrupt=skip_corrupt,
+                                      quarantine=quarantine)
+
   active = []
   pending = iter(files)
   for _ in range(cycle_length):
     path = next(pending, None)
     if path is not None:
-      # CRC verification is cheap (C impl) and turns silent shard corruption
-      # into a clear 'Corrupt TFRecord' error instead of misframed garbage.
-      active.append(tfrecord.tfrecord_iterator(path, verify_crc=True))
+      active.append(_reader(path))
   while active:
     done = []
     for it in active:
@@ -77,7 +86,7 @@ def _interleaved_records(filenames: List[str], cycle_length: int = 4,
       active.remove(it)
       path = next(pending, None)
       if path is not None:
-        active.append(tfrecord.tfrecord_iterator(path, verify_crc=True))
+        active.append(_reader(path))
 
 
 def _shuffled(records: Iterator[bytes], buffer_size: int,
@@ -100,7 +109,11 @@ class RecordDataset:
 
   def __init__(self, file_patterns: Union[str, Sequence[str]],
                dataset_key: str = '',
-               shard_index: int = 0, num_shards: int = 1):
+               shard_index: int = 0, num_shards: int = 1,
+               skip_corrupt_records: bool = False,
+               quarantine=None):
+    """``skip_corrupt_records``/``quarantine``: budgeted corrupt-record
+    tolerance (reliability.RecordQuarantine); off = corruption raises."""
     self.data_format, filenames = parse_file_patterns(file_patterns)
     # Multi-host: each process reads its slice of the shard list.
     self.filenames = filenames[shard_index::num_shards]
@@ -110,6 +123,11 @@ class RecordDataset:
           'Provide at least num_shards files for multi-host reads.'.format(
               shard_index, num_shards, len(filenames)))
     self.dataset_key = dataset_key
+    self.skip_corrupt_records = skip_corrupt_records
+    if skip_corrupt_records and quarantine is None:
+      from tensor2robot_tpu.reliability.quarantine import RecordQuarantine
+      quarantine = RecordQuarantine()
+    self.quarantine = quarantine
 
   def iter_records(self, shuffle: bool = False, shuffle_buffer: int = 500,
                    num_epochs: Optional[int] = None,
@@ -118,7 +136,9 @@ class RecordDataset:
     while num_epochs is None or epoch < num_epochs:
       records = _interleaved_records(
           self.filenames, shuffle_files=shuffle,
-          seed=None if seed is None else seed + epoch)
+          seed=None if seed is None else seed + epoch,
+          skip_corrupt=self.skip_corrupt_records,
+          quarantine=self.quarantine)
       if shuffle:
         records = _shuffled(records, shuffle_buffer,
                             None if seed is None else seed + epoch)

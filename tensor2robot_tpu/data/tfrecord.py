@@ -16,6 +16,13 @@ import os
 import struct
 from typing import Iterator, List, Optional
 
+from tensor2robot_tpu.reliability import fault_injection
+from tensor2robot_tpu.reliability.errors import (
+    CorruptRecordError,
+    InjectedFault,
+)
+from tensor2robot_tpu.reliability.quarantine import RecordQuarantine
+
 try:
   import google_crc32c
 
@@ -79,28 +86,80 @@ class TFRecordWriter:
 
 
 def tfrecord_iterator(path: str,
-                      verify_crc: bool = False) -> Iterator[bytes]:
-  """Yields raw record payloads from one TFRecord file."""
+                      verify_crc: bool = False,
+                      skip_corrupt: bool = False,
+                      quarantine: Optional[RecordQuarantine] = None
+                      ) -> Iterator[bytes]:
+  """Yields raw record payloads from one TFRecord file.
+
+  Fault model (docs/reliability.md): a bad *data* CRC leaves the framing
+  intact — with ``skip_corrupt`` the record is charged to ``quarantine``
+  and skipped. A bad *length* CRC or a truncated frame means the framing
+  itself is untrustworthy, so the remainder of the file is abandoned (one
+  record charge + a file-abandoned mark). Without ``skip_corrupt`` every
+  corruption raises ``CorruptRecordError`` (an IOError) as before. The
+  ``data.read`` FaultInjector site fires per record and is handled exactly
+  like a data-CRC corruption.
+  """
+  if skip_corrupt and quarantine is None:
+    quarantine = RecordQuarantine()
   with open(path, 'rb') as f:
+    index = 0
     while True:
       header = f.read(12)
+      if len(header) == 0:
+        return
       if len(header) < 12:
+        # Trailing partial frame: a truncated write (e.g. a crashed
+        # writer). Historically silent; in skip mode it is accounted.
+        if skip_corrupt:
+          quarantine.record_skipped(path, 'truncated header', index)
+          quarantine.file_abandoned(path, 'truncated header')
         return
       (length,) = struct.unpack('<Q', header[:8])
       if verify_crc:
         (expected,) = struct.unpack('<I', header[8:12])
         if _masked_crc(header[:8]) != expected:
-          raise IOError('Corrupt TFRecord length CRC in {}'.format(path))
+          if skip_corrupt:
+            # Framing lost: the length field itself is suspect, so there
+            # is no trustworthy way to find the next record boundary.
+            quarantine.record_skipped(path, 'length CRC', index)
+            quarantine.file_abandoned(path, 'length CRC')
+            return
+          raise CorruptRecordError(path, 'length CRC', index)
       data = f.read(length)
       if len(data) < length:
-        raise IOError('Truncated TFRecord in {}'.format(path))
+        if skip_corrupt:
+          quarantine.record_skipped(path, 'truncated data', index)
+          quarantine.file_abandoned(path, 'truncated data')
+          return
+        raise CorruptRecordError(path, 'truncation', index)
       footer = f.read(4)
       if len(footer) < 4:
-        raise IOError('Truncated TFRecord in {}'.format(path))
+        if skip_corrupt:
+          quarantine.record_skipped(path, 'truncated footer', index)
+          quarantine.file_abandoned(path, 'truncated footer')
+          return
+        raise CorruptRecordError(path, 'truncation', index)
       if verify_crc:
         (expected,) = struct.unpack('<I', footer)
         if _masked_crc(data) != expected:
-          raise IOError('Corrupt TFRecord data CRC in {}'.format(path))
+          index += 1
+          if skip_corrupt:
+            # Frame boundaries are still valid — only this record's
+            # payload is damaged; skip it and keep reading.
+            quarantine.record_skipped(path, 'data CRC', index - 1)
+            continue
+          raise CorruptRecordError(path, 'data CRC', index - 1)
+      try:
+        fault_injection.maybe_fail(fault_injection.SITE_DATA_READ)
+      except InjectedFault:
+        index += 1
+        if skip_corrupt:
+          quarantine.record_skipped(path, 'injected', index - 1)
+          continue
+        raise CorruptRecordError(path, 'injected', index - 1)
+      index += 1
       yield data
 
 

@@ -19,6 +19,8 @@ import numpy as np
 from tensor2robot_tpu.export.export_generators import make_serve_fn
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.reliability.errors import CHECKPOINT_SKIP_ERRORS
+from tensor2robot_tpu.reliability.logutil import log_warning
 from tensor2robot_tpu.specs import generators as spec_generators
 from tensor2robot_tpu.trainer import checkpointing
 
@@ -59,22 +61,45 @@ class CheckpointPredictor(AbstractPredictor):
     self._restored_step = 0
 
   def restore(self) -> bool:
-    """Busy-waits for a (new) checkpoint, then loads it (ref :134-179)."""
+    """Busy-waits for a (new) checkpoint, then loads it (ref :134-179).
+
+    The CheckpointManager retries transient save/restore failures with
+    backoff underneath; a checkpoint that still fails to load (half-written
+    by the trainer, deleted by retention GC mid-read) is skipped and the
+    loop keeps polling until the timeout — a robot-side consumer must not
+    die because it raced the trainer's filesystem commits.
+    """
     if self._checkpoint_dir is None:
       raise ValueError('CheckpointPredictor constructed without a '
                        'checkpoint_dir; call init_randomly() instead.')
-    deadline = time.time() + self._timeout
+    # monotonic: a wall-clock jump must not expire (or extend) the wait.
+    deadline = time.monotonic() + self._timeout
     while True:
-      step = checkpointing.latest_checkpoint_step(self._checkpoint_dir)
-      if step is not None and step != self._restored_step:
-        break
-      if self._restored_step is not None and step == self._restored_step:
+      steps = checkpointing.all_checkpoint_steps(self._checkpoint_dir)
+      floor = self._restored_step if self._restored_step is not None else -1
+      # Newest first, but never DOWNGRADE below what is already loaded: a
+      # permanently damaged newest step must not block serving when older
+      # intact checkpoints sit in the same directory.
+      candidates = [s for s in steps if s > floor]
+      if not candidates and self._restored_step is not None and steps:
         return True  # nothing newer; current state is still valid
-      if time.time() > deadline:
+      for step in candidates:
+        try:
+          return self._load_step(step)
+        except CHECKPOINT_SKIP_ERRORS as e:
+          log_warning(
+              'CheckpointPredictor: step %d in %s failed to restore (%s); '
+              'trying an older checkpoint.', step, self._checkpoint_dir, e)
+      if time.monotonic() > deadline:
         return False
       time.sleep(_POLL_INTERVAL_SECS)
+
+  def _load_step(self, step: int) -> bool:
+    # quarantine_damaged=False: this is a read-only consumer of another
+    # process's training directory; it must never rename files there.
     manager = checkpointing.CheckpointManager(self._checkpoint_dir,
-                                              async_checkpoints=False)
+                                              async_checkpoints=False,
+                                              quarantine_damaged=False)
     try:
       restored = manager.restore(None, step=step)
     finally:

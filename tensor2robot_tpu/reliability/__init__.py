@@ -1,0 +1,70 @@
+"""Fault-tolerance substrate: retrying I/O, fault injection, quarantine.
+
+The production T2R role (QT-Opt-scale off-policy RL feeding live robots,
+SURVEY.md §2) assumes runs that survive weeks of preemptions, flaky
+filesystems, and dirty logged data. This package holds the generic
+machinery; the trainer/, data/, and predictors/ layers wire it in at the
+named fault sites (docs/reliability.md):
+
+  * ``retry`` / ``RetryPolicy`` — bounded exponential backoff + jitter
+    around transient I/O (checkpoint save/restore, record reads).
+  * ``FaultInjector`` — deterministic, site-addressed fault injection
+    (``ckpt.save``, ``ckpt.restore``, ``data.read``, ``step.nan``) so the
+    recovery paths are testable without real flaky hardware.
+  * ``RecordQuarantine`` — corrupt-record accounting with per-file and
+    global budgets; counters surface in train metrics.
+  * ``graceful_shutdown`` — SIGTERM/SIGINT → emergency checkpoint.
+"""
+
+from tensor2robot_tpu.reliability.errors import (
+    CorruptCheckpointError,
+    CorruptionBudgetExceeded,
+    CorruptRecordError,
+    InjectedFault,
+    NonFiniteLossError,
+    RetryError,
+    TrainingPreempted,
+    TRANSIENT_IO_ERRORS,
+)
+from tensor2robot_tpu.reliability.fault_injection import (
+    FaultInjector,
+    SITE_CKPT_RESTORE,
+    SITE_CKPT_SAVE,
+    SITE_DATA_READ,
+    SITE_STEP_NAN,
+    configure_fault_injector,
+    get_injector,
+    set_injector,
+)
+from tensor2robot_tpu.reliability.preemption import graceful_shutdown
+from tensor2robot_tpu.reliability.quarantine import (
+    RecordQuarantine,
+    aggregate_metrics,
+    reset_aggregate_metrics,
+)
+from tensor2robot_tpu.reliability.retry import RetryPolicy, retry
+
+__all__ = [
+    'CorruptCheckpointError',
+    'CorruptRecordError',
+    'CorruptionBudgetExceeded',
+    'FaultInjector',
+    'InjectedFault',
+    'NonFiniteLossError',
+    'RecordQuarantine',
+    'RetryError',
+    'RetryPolicy',
+    'SITE_CKPT_RESTORE',
+    'SITE_CKPT_SAVE',
+    'SITE_DATA_READ',
+    'SITE_STEP_NAN',
+    'TRANSIENT_IO_ERRORS',
+    'TrainingPreempted',
+    'aggregate_metrics',
+    'configure_fault_injector',
+    'get_injector',
+    'graceful_shutdown',
+    'reset_aggregate_metrics',
+    'retry',
+    'set_injector',
+]

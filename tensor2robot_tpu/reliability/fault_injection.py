@@ -1,0 +1,143 @@
+"""Deterministic, site-addressed fault injection.
+
+A ``FaultInjector`` arms failures at named call sites; the wired-up layers
+call ``maybe_fail(site)`` (raising sites) or ``fires(site)`` (boolean
+sites) on every pass through. Determinism comes from counting calls per
+site — "fail calls 3 and 4 of ``ckpt.save``" reproduces exactly, with no
+randomness — which is what lets tests drive a specific recovery path.
+
+Sites wired in this codebase (docs/reliability.md):
+  * ``ckpt.save``     CheckpointManager.save, inside the retry loop
+  * ``ckpt.restore``  CheckpointManager.restore, inside the retry loop
+  * ``data.read``     tfrecord record reads → treated as a corrupt record
+  * ``step.nan``      trainer train step → forces a non-finite loss
+
+The injector is config-registrable: bind ``configure_fault_injector`` in a
+gin file to arm faults for a whole run without touching code.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from tensor2robot_tpu.reliability.errors import InjectedFault
+
+SITE_CKPT_SAVE = 'ckpt.save'
+SITE_CKPT_RESTORE = 'ckpt.restore'
+SITE_DATA_READ = 'data.read'
+SITE_STEP_NAN = 'step.nan'
+
+KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
+               SITE_STEP_NAN)
+
+
+class FaultInjector:
+  """Counts calls per site and fires armed failures deterministically."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    # site -> list of call indices (0-based) that must fail.
+    self._armed: Dict[str, List[int]] = {}
+    self._calls: Dict[str, int] = {}
+    self._fired: Dict[str, int] = {}
+
+  def fail(self, site: str, times: int = 1, after: int = 0) -> 'FaultInjector':
+    """Arms ``times`` consecutive failures at ``site``, skipping the first
+    ``after`` calls. Returns self for chaining."""
+    with self._lock:
+      already = self._calls.get(site, 0)
+      armed = self._armed.setdefault(site, [])
+      start = already + after
+      armed.extend(range(start, start + times))
+    return self
+
+  def fires(self, site: str) -> bool:
+    """Consumes one call at ``site``; True when an armed failure fires.
+
+    The boolean form for sites that do not raise (``step.nan``).
+    """
+    with self._lock:
+      index = self._calls.get(site, 0)
+      self._calls[site] = index + 1
+      armed = self._armed.get(site, ())
+      if index in armed:
+        self._fired[site] = self._fired.get(site, 0) + 1
+        return True
+      return False
+
+  def maybe_fail(self, site: str) -> None:
+    """Consumes one call at ``site``; raises InjectedFault when armed."""
+    if self.fires(site):
+      raise InjectedFault(site, self._calls.get(site, 1) - 1)
+
+  def call_count(self, site: str) -> int:
+    with self._lock:
+      return self._calls.get(site, 0)
+
+  def fired_count(self, site: str) -> int:
+    with self._lock:
+      return self._fired.get(site, 0)
+
+  def reset(self) -> None:
+    with self._lock:
+      self._armed.clear()
+      self._calls.clear()
+      self._fired.clear()
+
+
+_INJECTOR: Optional[FaultInjector] = None
+_INJECTOR_LOCK = threading.Lock()
+
+
+def get_injector() -> Optional[FaultInjector]:
+  """The process-wide injector, or None when fault injection is off."""
+  return _INJECTOR
+
+
+def set_injector(injector: Optional[FaultInjector]) -> None:
+  global _INJECTOR
+  with _INJECTOR_LOCK:
+    _INJECTOR = injector
+
+
+def maybe_fail(site: str) -> None:
+  """Module-level hook the instrumented sites call; no-op when disabled."""
+  injector = _INJECTOR
+  if injector is not None:
+    injector.maybe_fail(site)
+
+
+def fires(site: str) -> bool:
+  injector = _INJECTOR
+  if injector is not None:
+    return injector.fires(site)
+  return False
+
+
+FaultSpec = Union[Dict[str, int], Sequence[Union[Tuple[str, int],
+                                                 Tuple[str, int, int]]]]
+
+
+def configure_fault_injector(
+    failures: Optional[FaultSpec] = None) -> Optional[FaultInjector]:
+  """Installs a process-wide injector from a config-friendly spec.
+
+  ``failures`` is either ``{'ckpt.save': 2}`` (fail the first 2 calls per
+  site) or ``[('data.read', 1, 5), ...]`` tuples of
+  ``(site, times[, after])``. ``None``/empty uninstalls the injector.
+  Gin-registrable (config/registry.py) so a run can arm faults from its
+  config file alone.
+  """
+  if not failures:
+    set_injector(None)
+    return None
+  injector = FaultInjector()
+  if isinstance(failures, dict):
+    items = [(site, times, 0) for site, times in failures.items()]
+  else:
+    items = [tuple(entry) + (0,) * (3 - len(entry)) for entry in failures]
+  for site, times, after in items:
+    injector.fail(site, times=int(times), after=int(after))
+  set_injector(injector)
+  return injector

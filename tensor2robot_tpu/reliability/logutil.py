@@ -1,0 +1,18 @@
+"""Deferred absl warning logger shared by the wired-up layers.
+
+absl is optional at import time across this codebase (library modules
+defer it); the reliability call sites all want the same warning-level
+logger, so the deferral lives once here.
+"""
+
+from __future__ import annotations
+
+_logv = None
+
+
+def log_warning(msg: str, *args) -> None:
+  global _logv
+  if _logv is None:
+    from absl import logging as _absl_logging  # deferred: absl optional
+    _logv = _absl_logging.warning
+  _logv(msg, *args)

@@ -1,0 +1,120 @@
+"""Corrupt-record quarantine: bounded tolerance with loud exhaustion.
+
+``skip_corrupt_records`` mode never hides damage: every skipped record and
+abandoned file is counted per-file and process-wide, the counters surface
+in train metrics (trainer/train_eval.py log path), and blowing either the
+per-file or the global budget raises ``CorruptionBudgetExceeded`` naming
+the offending file — dirty data degrades gracefully up to a configured
+point, then fails the run on purpose.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from tensor2robot_tpu.reliability.errors import CorruptionBudgetExceeded
+
+# Process-wide totals, aggregated across every RecordQuarantine so the
+# trainer can surface them without holding references to the generators'
+# instances (generators may live behind prefetch threads).
+_TOTALS_LOCK = threading.Lock()
+_TOTAL_RECORDS_SKIPPED = 0
+_TOTAL_FILES_ABANDONED = 0
+
+
+def aggregate_metrics() -> Dict[str, float]:
+  """Counters for the train-metrics writer (monotonic within a process)."""
+  with _TOTALS_LOCK:
+    return {
+        'data/corrupt_records_skipped': float(_TOTAL_RECORDS_SKIPPED),
+        'data/corrupt_files_abandoned': float(_TOTAL_FILES_ABANDONED),
+    }
+
+
+def reset_aggregate_metrics() -> None:
+  """Test hook: zero the process-wide counters."""
+  global _TOTAL_RECORDS_SKIPPED, _TOTAL_FILES_ABANDONED
+  with _TOTALS_LOCK:
+    _TOTAL_RECORDS_SKIPPED = 0
+    _TOTAL_FILES_ABANDONED = 0
+
+
+class RecordQuarantine:
+  """Counts corrupt records against per-file and global budgets."""
+
+  def __init__(self,
+               max_corrupt_records: int = 100,
+               max_corrupt_records_per_file: int = 10):
+    """Budgets are inclusive tolerances: the (N+1)-th corrupt record over
+    either limit raises. Pass 0 to fail on the first corruption (i.e.
+    counting without tolerance); budgets never go negative."""
+    self._lock = threading.Lock()
+    self._max_total = int(max_corrupt_records)
+    self._max_per_file = int(max_corrupt_records_per_file)
+    self._skipped_by_file: Dict[str, int] = {}
+    self._abandoned_files: Dict[str, str] = {}
+    self._skipped_total = 0
+    self._charged: set = set()  # (path, record_index) already counted
+
+  @property
+  def records_skipped(self) -> int:
+    with self._lock:
+      return self._skipped_total
+
+  @property
+  def files_abandoned(self) -> int:
+    with self._lock:
+      return len(self._abandoned_files)
+
+  def skipped_in_file(self, path: str) -> int:
+    with self._lock:
+      return self._skipped_by_file.get(path, 0)
+
+  def record_skipped(self, path: str, reason: str = '',
+                     record_index: Optional[int] = None) -> None:
+    """Charges one corrupt record to ``path``; raises when a budget blows.
+
+    ``record_index`` (the record's position in the file) dedupes charges:
+    multi-epoch runs re-read the same shards, and the same physically
+    corrupt record must count against the budget once, not once per
+    epoch — otherwise a small fixed amount of damage kills a long run.
+    """
+    global _TOTAL_RECORDS_SKIPPED
+    with self._lock:
+      if record_index is not None:
+        key = (path, record_index)
+        if key in self._charged:
+          return
+        self._charged.add(key)
+      self._skipped_total += 1
+      in_file = self._skipped_by_file.get(path, 0) + 1
+      self._skipped_by_file[path] = in_file
+      over_file = in_file > self._max_per_file
+      over_total = self._skipped_total > self._max_total
+    with _TOTALS_LOCK:
+      _TOTAL_RECORDS_SKIPPED += 1
+    if over_file:
+      raise CorruptionBudgetExceeded(path, 'file', self._max_per_file)
+    if over_total:
+      raise CorruptionBudgetExceeded(path, 'global', self._max_total)
+
+  def file_abandoned(self, path: str, reason: str = '') -> None:
+    """Marks the remainder of ``path`` unreadable (framing lost)."""
+    global _TOTAL_FILES_ABANDONED
+    newly = False
+    with self._lock:
+      if path not in self._abandoned_files:
+        self._abandoned_files[path] = reason
+        newly = True
+    if newly:
+      with _TOTALS_LOCK:
+        _TOTAL_FILES_ABANDONED += 1
+
+  def summary(self) -> Dict[str, object]:
+    with self._lock:
+      return {
+          'records_skipped': self._skipped_total,
+          'by_file': dict(self._skipped_by_file),
+          'abandoned_files': dict(self._abandoned_files),
+      }

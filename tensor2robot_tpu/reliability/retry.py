@@ -1,0 +1,92 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The one generic retry primitive every I/O layer shares (checkpoint
+save/restore, predictor restore, record reads). Deliberately synchronous
+and dependency-free: callers wrap the *smallest* failing operation, not
+whole loops, so a retry never replays side effects that already landed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from tensor2robot_tpu.reliability.errors import (
+    RetryError,
+    TRANSIENT_IO_ERRORS,
+)
+
+T = TypeVar('T')
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+  """How to retry one site.
+
+  Attributes:
+    max_attempts: total tries (1 = no retry).
+    base_delay_secs: delay before the first retry.
+    backoff: multiplier per further retry.
+    max_delay_secs: delay ceiling.
+    jitter: extra uniform-random fraction of the delay in [0, jitter],
+      decorrelating fleets that fail together. Seed the rng via ``retry``'s
+      ``rng`` argument for determinism in tests; jitter=0 disables.
+    retryable: exception types worth retrying. Everything else propagates
+      immediately (a deterministic error does not get better with sleep).
+  """
+
+  max_attempts: int = 3
+  base_delay_secs: float = 0.05
+  backoff: float = 2.0
+  max_delay_secs: float = 5.0
+  jitter: float = 0.1
+  retryable: Tuple[Type[BaseException], ...] = TRANSIENT_IO_ERRORS
+
+  def delay_secs(self, retry_index: int,
+                 rng: Optional[random.Random] = None) -> float:
+    delay = min(self.base_delay_secs * (self.backoff ** retry_index),
+                self.max_delay_secs)
+    if self.jitter:
+      delay *= 1.0 + self.jitter * (rng or random).random()
+    return delay
+
+
+def retry(fn: Callable[[], T],
+          policy: Optional[RetryPolicy] = None,
+          site: Optional[str] = None,
+          sleep: Callable[[float], None] = time.sleep,
+          rng: Optional[random.Random] = None,
+          on_retry: Optional[Callable[[str, int, BaseException, float],
+                                      None]] = None) -> T:
+  """Calls ``fn`` until it succeeds or the policy is exhausted.
+
+  Args:
+    fn: zero-arg operation; its return value is passed through.
+    policy: RetryPolicy; None uses the defaults.
+    site: name for error messages / ``on_retry`` (e.g. 'ckpt.save').
+    sleep: injectable for tests.
+    rng: injectable random.Random for deterministic jitter.
+    on_retry: callback(site, retry_index, exception, delay_secs) fired
+      before each sleep.
+
+  Raises:
+    RetryError: wrapping the last retryable failure once attempts run out.
+    Any non-retryable exception: immediately, unwrapped.
+  """
+  policy = policy or RetryPolicy()
+  attempts = max(1, policy.max_attempts)
+  last: Optional[BaseException] = None
+  for attempt in range(attempts):
+    try:
+      return fn()
+    except policy.retryable as e:  # pylint: disable=catching-non-exception
+      last = e
+      if attempt + 1 >= attempts:
+        break
+      delay = policy.delay_secs(attempt, rng=rng)
+      if on_retry is not None:
+        on_retry(site or '', attempt, e, delay)
+      sleep(delay)
+  raise RetryError(site, attempts, last) from last

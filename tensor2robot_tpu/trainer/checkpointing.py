@@ -29,7 +29,18 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.reliability import fault_injection
+from tensor2robot_tpu.reliability.errors import CorruptCheckpointError
+from tensor2robot_tpu.reliability.logutil import log_warning as _log
+from tensor2robot_tpu.reliability.retry import RetryPolicy, retry
+
 CHECKPOINT_SUBDIR = 'checkpoints'
+
+# Shared default for checkpoint I/O: 3 attempts, ~0.05/0.1s backoff. Kept
+# short — checkpoint saves sit on the training hot loop, and a filesystem
+# that stays down for longer than this should fail the run (RetryError)
+# rather than stall it silently.
+DEFAULT_CKPT_RETRY = RetryPolicy(max_attempts=3, base_delay_secs=0.05)
 
 # Version of the in-checkpoint parameter LAYOUT (not the tree structure).
 # Layout changes are shape-compatible but numerically incompatible — a
@@ -52,7 +63,9 @@ class CheckpointManager:
                async_checkpoints: bool = True,
                best_fn: Optional[Callable[[Any], float]] = None,
                best_mode: str = 'min',
-               assume_param_layout: Optional[int] = None):
+               assume_param_layout: Optional[int] = None,
+               retry_policy: Optional[RetryPolicy] = None,
+               quarantine_damaged: bool = True):
     """Args mirror the reference's gin-exposed Saver/RunConfig knobs.
 
     Args:
@@ -71,10 +84,21 @@ class CheckpointManager:
         the current ``PARAM_LAYOUT_VERSION`` stamps the marker and lets
         the run resume; any other value (or None, the default) keeps
         the loud failure.
+      retry_policy: backoff policy for transient save/restore failures
+        (flaky NFS/GCS); None uses DEFAULT_CKPT_RETRY. Non-transient
+        errors (layout mismatch, bad template) propagate immediately.
+      quarantine_damaged: rename visibly damaged step dirs aside
+        (``<step>.corrupt``) when a restore trips over them. Only the
+        manager that OWNS the directory (the trainer's) should do this;
+        read-only consumers (predictors, warm starts) pass False so a
+        polling reader never mutates a live training directory.
     """
     self._assume_param_layout = assume_param_layout
+    self._retry_policy = retry_policy or DEFAULT_CKPT_RETRY
+    self._quarantine_damaged = quarantine_damaged
+    self._keep_checkpoint_max = keep_checkpoint_max
     self.directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
-    options = ocp.CheckpointManagerOptions(
+    self._options = ocp.CheckpointManagerOptions(
         max_to_keep=keep_checkpoint_max,
         save_interval_steps=save_interval_steps,
         enable_async_checkpointing=async_checkpoints,
@@ -82,14 +106,25 @@ class CheckpointManager:
         best_mode=best_mode,
         create=True,
     )
-    self._manager = ocp.CheckpointManager(self.directory, options=options)
+    self._manager = ocp.CheckpointManager(self.directory,
+                                          options=self._options)
 
   def save(self, step: int, state, metrics: Optional[dict] = None,
            force: bool = False) -> bool:
-    self._write_format_marker()
-    return self._manager.save(
-        int(step), args=ocp.args.StandardSave(state), metrics=metrics,
-        force=force)
+    # Marker I/O hits the same flaky mount as the checkpoint itself:
+    # retry it too. (Its deterministic ValueErrors are not retryable and
+    # pass straight through.)
+    retry(self._write_format_marker, self._retry_policy,
+          site=fault_injection.SITE_CKPT_SAVE)
+
+    def _save():
+      fault_injection.maybe_fail(fault_injection.SITE_CKPT_SAVE)
+      return self._manager.save(
+          int(step), args=ocp.args.StandardSave(state), metrics=metrics,
+          force=force)
+
+    return retry(_save, self._retry_policy,
+                 site=fault_injection.SITE_CKPT_SAVE)
 
   def restore(self, state_template, step: Optional[int] = None):
     """Restores into the structure/shardings of ``state_template``.
@@ -102,9 +137,119 @@ class CheckpointManager:
     if step is None:
       raise FileNotFoundError(
           'No checkpoint found in {}.'.format(self.directory))
-    self._check_format_marker()
-    return self._manager.restore(
-        int(step), args=ocp.args.StandardRestore(state_template))
+    retry(self._check_format_marker, self._retry_policy,
+          site=fault_injection.SITE_CKPT_RESTORE)
+
+    def _restore():
+      fault_injection.maybe_fail(fault_injection.SITE_CKPT_RESTORE)
+      return self._manager.restore(
+          int(step), args=ocp.args.StandardRestore(state_template))
+
+    try:
+      return retry(_restore, self._retry_policy,
+                   site=fault_injection.SITE_CKPT_RESTORE)
+    except (ValueError, KeyError) as e:
+      # Orbax reports a half-written or GC-gutted step dir as assorted
+      # ValueErrors ('Must provide args of type Composite...') — these
+      # are non-retryable, so they arrive here after the FIRST attempt
+      # (a damaged dir does not get better with backoff). When a step is
+      # visibly damaged on disk, quarantine it (rename aside — a damaged
+      # dir also poisons the manager's item-layout inference for EVERY
+      # step) and reclassify as CorruptCheckpointError so skip layers
+      # can ride it out; a ValueError with all checkpoints intact (bad
+      # template, layout mismatch) stays fatal.
+      damage = self._step_damage(int(step))
+      if damage is not None:
+        self._quarantine_damaged_step(int(step), damage)
+        raise CorruptCheckpointError(self.directory, int(step),
+                                     damage) from e
+      damaged_other = []
+      for s in self._on_disk_steps():
+        other_damage = self._step_damage(s)
+        if other_damage is not None:
+          damaged_other.append((s, other_damage))
+      if damaged_other:
+        # The requested step is intact; a DIFFERENT damaged step poisoned
+        # the manager's construction-time item-layout inference. Clean up
+        # (owner only), then read the requested step directly, bypassing
+        # the poisoned manager — an intact newest checkpoint must never
+        # be skipped because an older one is damaged.
+        for other, other_damage in damaged_other:
+          self._quarantine_damaged_step(other, other_damage)
+        try:
+          return self._restore_step_direct(int(step), state_template)
+        except Exception as direct_error:  # noqa: BLE001 — reclassified
+          raise CorruptCheckpointError(
+              self.directory, damaged_other[0][0],
+              damaged_other[0][1] + ' (poisoned the restore of step {}; '
+              'direct read also failed: {})'.format(
+                  step, direct_error)) from e
+      raise
+
+  def _restore_step_direct(self, step: int, state_template):
+    """Reads one step's 'default' item without the (poisoned) manager."""
+    item_dir = os.path.join(self.directory, str(step), 'default')
+    checkpointer = ocp.StandardCheckpointer()
+    try:
+      return checkpointer.restore(item_dir, target=state_template)
+    finally:
+      checkpointer.close()
+
+  def _on_disk_steps(self):
+    if not os.path.isdir(self.directory):
+      return []
+    return sorted(int(name) for name in os.listdir(self.directory)
+                  if name.isdigit())
+
+  def _step_damage(self, step: int) -> Optional[str]:
+    """Describes visible on-disk damage for ``step``, or None if intact.
+
+    Conservative on purpose: only conditions an atomically-committed orbax
+    step can never exhibit (missing/empty dir, no _CHECKPOINT_METADATA)
+    count as damage — they arise from retention GC or a crashed commit.
+    """
+    step_dir = os.path.join(self.directory, str(step))
+    if not os.path.isdir(step_dir):
+      return 'step directory missing'
+    entries = os.listdir(step_dir)
+    if not entries:
+      return 'step directory empty'
+    if '_CHECKPOINT_METADATA' not in entries:
+      return 'checkpoint metadata missing'
+    return None
+
+  def _quarantine_damaged_step(self, step: int, damage: str) -> None:
+    """Renames a damaged step dir aside and rebuilds the orbax manager.
+
+    The rename (never a delete — the bytes stay for forensics) both stops
+    pollers from rediscovering the broken step and un-poisons orbax's
+    construction-time item-layout inference; the rebuild makes the fresh
+    layout visible to this manager. No-op unless this manager owns the
+    directory (``quarantine_damaged``) — a read-only consumer must not
+    mutate a training run's files out from under the trainer.
+    """
+    if not self._quarantine_damaged:
+      return
+    src = os.path.join(self.directory, str(step))
+    if os.path.isdir(src):
+      dest = src + '.corrupt'
+      suffix = 1
+      while os.path.exists(dest):
+        dest = '{}.corrupt{}'.format(src, suffix)
+        suffix += 1
+      try:
+        os.replace(src, dest)
+        _log('Quarantined damaged checkpoint step %d (%s): %s -> %s',
+             step, damage, src, dest)
+      except OSError as e:
+        _log('Could not quarantine damaged checkpoint %s: %s', src, e)
+        return
+    try:
+      self._manager.close()
+    except Exception as e:  # noqa: BLE001 — already on the failure path
+      _log('Closing poisoned checkpoint manager failed: %s', e)
+    self._manager = ocp.CheckpointManager(self.directory,
+                                          options=self._options)
 
   def _stamp_marker(self) -> None:
     path = os.path.join(self.directory, _FORMAT_FILENAME)
@@ -202,15 +347,23 @@ class CheckpointManager:
     self.close()
 
 
-def latest_checkpoint_step(model_dir: str) -> Optional[int]:
-  """Newest committed checkpoint step under model_dir, or None."""
+def all_checkpoint_steps(model_dir: str) -> list:
+  """All committed checkpoint steps under model_dir, newest first.
+
+  Orbax commits atomically by renaming; a bare numeric dir is live
+  (in-flight saves have an .orbax-checkpoint-tmp suffix and fail isdigit).
+  """
   directory = os.path.join(model_dir, CHECKPOINT_SUBDIR)
   if not os.path.isdir(directory):
-    return None
-  # Orbax commits atomically by renaming; a bare numeric dir is live
-  # (in-flight saves have an .orbax-checkpoint-tmp suffix and fail isdigit).
-  steps = [int(name) for name in os.listdir(directory) if name.isdigit()]
-  return max(steps) if steps else None
+    return []
+  return sorted((int(name) for name in os.listdir(directory)
+                 if name.isdigit()), reverse=True)
+
+
+def latest_checkpoint_step(model_dir: str) -> Optional[int]:
+  """Newest committed checkpoint step under model_dir, or None."""
+  steps = all_checkpoint_steps(model_dir)
+  return steps[0] if steps else None
 
 
 def checkpoints_iterator(model_dir: str,
@@ -224,17 +377,19 @@ def checkpoints_iterator(model_dir: str,
   ``stop_fn`` returns True.
   """
   last_step = None
-  deadline = time.time() + timeout_secs
+  # monotonic, not time.time(): a wall-clock jump (NTP step, DST) must not
+  # spuriously expire — or indefinitely extend — the eval timeout.
+  deadline = time.monotonic() + timeout_secs
   while True:
     if stop_fn is not None and stop_fn():
       return
     step = latest_checkpoint_step(model_dir)
     if step is not None and step != last_step:
       last_step = step
-      deadline = time.time() + timeout_secs
+      deadline = time.monotonic() + timeout_secs
       yield step
       continue
-    if time.time() > deadline:
+    if time.monotonic() > deadline:
       return
     time.sleep(min_interval_secs)
 
@@ -256,7 +411,9 @@ def create_warm_start_fn(checkpoint_dir: str,
   """
 
   def warm_start(params):
-    manager = CheckpointManager(checkpoint_dir, async_checkpoints=False)
+    # Read-only against a foreign run's directory: never quarantine there.
+    manager = CheckpointManager(checkpoint_dir, async_checkpoints=False,
+                                quarantine_damaged=False)
     try:
       restore_step = step if step is not None else manager.latest_step()
       if restore_step is None:

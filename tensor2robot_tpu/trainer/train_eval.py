@@ -20,6 +20,7 @@ and the model_fn skeleton it drives (/root/reference/models/abstract_model.py
 from __future__ import annotations
 
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -37,9 +38,19 @@ from tensor2robot_tpu.parallel import sharding as sharding_lib
 from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
     Bfloat16PreprocessorWrapper,
 )
+from tensor2robot_tpu.reliability import fault_injection
+from tensor2robot_tpu.reliability import quarantine as quarantine_lib
+from tensor2robot_tpu.reliability.errors import (
+    CHECKPOINT_SKIP_ERRORS,
+    NonFiniteLossError,
+    TrainingPreempted,
+)
+from tensor2robot_tpu.reliability.preemption import graceful_shutdown
 from tensor2robot_tpu.specs import assets as assets_lib
 from tensor2robot_tpu.specs.struct import SpecStruct
 from tensor2robot_tpu.trainer import checkpointing
+
+NAN_POLICIES = ('off', 'skip', 'raise', 'rollback')
 
 _logv = None
 
@@ -81,12 +92,31 @@ class Trainer:
                use_avg_params_for_eval: Optional[bool] = None,
                write_metrics: bool = True,
                eval_name: Optional[str] = None,
-               profile_steps: Optional[Sequence[int]] = None):
+               profile_steps: Optional[Sequence[int]] = None,
+               nan_policy: str = 'skip',
+               nan_rollback_budget: int = 3,
+               nan_check_every_n_steps: int = 1,
+               owns_checkpoint_dir: bool = True):
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
     per-eval-run dirs, ref utils/train_eval.py:539-547).
     profile_steps: (start, stop) global steps bracketing ONE
-    jax.profiler trace written under model_dir/plugins (SURVEY §5)."""
+    jax.profiler trace written under model_dir/plugins (SURVEY §5).
+    nan_policy: what the non-finite-loss sentinel does
+    (docs/reliability.md): 'skip' (default) discards the poisoned update
+    on device — params/opt state keep their pre-step values, only the
+    step counter advances, zero host syncs; 'rollback' restores the last
+    committed checkpoint (at most ``nan_rollback_budget`` times per
+    train() call, then raises NonFiniteLossError); 'raise' fails
+    immediately; 'off' reproduces the unguarded seed behavior.
+    nan_check_every_n_steps: host-side loss check cadence for
+    'raise'/'rollback' (each check syncs the device; 'skip' never does).
+    owns_checkpoint_dir: whether this trainer is the writer of
+    model_dir's checkpoints. False for eval-only jobs sharing a live
+    training directory: their manager then never quarantines (renames)
+    damaged step dirs out from under the owning trainer
+    (checkpointing.CheckpointManager quarantine_damaged).
+    """
     self.model = model
     self.model_dir = model_dir
     self.mesh = mesh if mesh is not None else mesh_lib.create_mesh()
@@ -107,7 +137,8 @@ class Trainer:
         model_dir,
         keep_checkpoint_max=keep_checkpoint_max,
         save_interval_steps=1,
-        async_checkpoints=async_checkpoints)
+        async_checkpoints=async_checkpoints,
+        quarantine_damaged=owns_checkpoint_dir)
     self._state_sharding = None
     self._train_step_fn = None
     self._eval_step_fn = None
@@ -118,6 +149,12 @@ class Trainer:
     self._eval_name = eval_name
     self._profile_steps = tuple(profile_steps) if profile_steps else None
     self._profiling = False
+    if nan_policy not in NAN_POLICIES:
+      raise ValueError('nan_policy must be one of {}; got {!r}.'.format(
+          NAN_POLICIES, nan_policy))
+    self._nan_policy = nan_policy
+    self._nan_rollback_budget = int(nan_rollback_budget)
+    self._nan_check_every_n_steps = max(1, int(nan_check_every_n_steps))
     self._train_writer = None
     self._eval_writer = None
     self._device_feed = None
@@ -203,14 +240,28 @@ class Trainer:
     # Re-read disk: a concurrent trainer may have written checkpoints
     # since this manager was constructed (continuous-eval topology).
     self.checkpoint_manager.reload()
-    latest = self.checkpoint_manager.latest_step()
-    if latest is not None:
-      _log('Restoring checkpoint at step %d from %s', latest, self.model_dir)
+    steps = sorted(self.checkpoint_manager.all_steps(), reverse=True)
+    if steps:
       template = jax.tree.map(
           lambda leaf, s: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
                                                sharding=s),
           abstract_state, self._state_sharding)
-      return self.checkpoint_manager.restore(template, step=latest)
+      # Newest first, skipping checkpoints that fail to restore for
+      # transient reasons (half-written by a concurrent trainer, deleted
+      # by retention GC between listing and read, flaky filesystem). A
+      # restore problem that hits EVERY committed step is real: re-raise
+      # rather than silently reinitializing and discarding the run.
+      last_error = None
+      for candidate in steps:
+        _log('Restoring checkpoint at step %d from %s', candidate,
+             self.model_dir)
+        try:
+          return self.checkpoint_manager.restore(template, step=candidate)
+        except CHECKPOINT_SKIP_ERRORS as e:
+          last_error = e
+          _log('Checkpoint %d in %s failed to restore (%s); trying the '
+               'previous one.', candidate, self.model_dir, e)
+      raise last_error
     # No checkpoint: this is a FRESH state. Callers chaining train() calls
     # without checkpointing must thread the returned state explicitly or
     # each call restarts from initialization — log so that's visible.
@@ -238,8 +289,9 @@ class Trainer:
     if self._train_step_fn is not None:
       return self._train_step_fn
     model = self.model
+    nan_policy = self._nan_policy
 
-    def step(state, features, labels, base_rng):
+    def step(state, features, labels, base_rng, force_nan):
       # Fold the step into the rng on-device: no host round-trip per step.
       rng = jax.random.fold_in(base_rng, state.step)
       pre_rng, step_rng = jax.random.split(rng)
@@ -251,15 +303,44 @@ class Trainer:
           SpecStruct(**features),
           SpecStruct(**labels) if labels is not None else None,
           ModeKeys.TRAIN, rng=pre_rng)
-      return model.train_step(state, features, labels, step_rng)
+      new_state, metrics = model.train_step(state, features, labels,
+                                            step_rng)
+      metrics = dict(metrics)
+      loss = metrics.get('loss')
+      if loss is not None:
+        # ``force_nan`` is the FaultInjector's 'step.nan' site: a traced
+        # scalar (no recompile per toggle) poisoning the loss on device.
+        loss = jnp.where(force_nan, jnp.nan, loss)
+        metrics['loss'] = loss
+        if nan_policy == 'skip':
+          # Discard a poisoned update without leaving the device: every
+          # leaf keeps its pre-step value when the loss is non-finite,
+          # except the step counter, which advances so loop/bookkeeping
+          # and checkpoint steps stay aligned ("batch dropped").
+          good = jnp.all(jnp.isfinite(loss))
+          guarded = jax.tree.map(
+              lambda new, old: jnp.where(good, new, old), new_state, state)
+          new_state = guarded.replace(step=new_state.step)
+          metrics['nonfinite_loss_skipped'] = 1 - good.astype(jnp.int32)
+      return new_state, metrics
 
     batch = self._batch_sharding()
     replicated = NamedSharding(self.mesh, P())
-    self._train_step_fn = jax.jit(
+    jitted = jax.jit(
         step,
-        in_shardings=(self._state_sharding, batch, batch, replicated),
+        in_shardings=(self._state_sharding, batch, batch, replicated,
+                      replicated),
         out_shardings=(self._state_sharding, replicated),
         donate_argnums=(0,))
+
+    def call(state, features, labels, base_rng, force_nan=None):
+      # force_nan defaults off so external callers of the compiled step
+      # (tests, rl/offpolicy) keep the pre-reliability 4-arg signature.
+      if force_nan is None:
+        force_nan = np.asarray(False)
+      return jitted(state, features, labels, base_rng, force_nan)
+
+    self._train_step_fn = call
     return self._train_step_fn
 
   def _compile_eval_step(self):
@@ -344,47 +425,158 @@ class Trainer:
     metrics = None
     step_i = start_step
     batch = (features, labels)
-    while step_i < max_train_steps:
-      self._maybe_profile(step_i)
-      features, labels = batch
-      device_batch = self._put_batch(
-          {'features': features.to_dict(),
-           'labels': labels.to_dict() if labels is not None else None})
-      state, metrics = step_fn(state, device_batch['features'],
-                               device_batch['labels'], base_rng)
-      step_i += 1
-      steps_since_log += 1
-      if step_i % self.log_every_n_steps == 0 or step_i == max_train_steps:
-        metrics = jax.device_get(dict(metrics))
-        dt = time.time() - t_last
-        examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
-        self._throughput = (examples_per_sec, dt / max(steps_since_log, 1))
-        _log('step %d: loss=%s (%.1f examples/sec)', step_i,
-             metrics.get('loss'), examples_per_sec)
-        writer = self.train_metrics_writer
-        if writer is not None:
-          scalars = {k: float(np.mean(v)) for k, v in metrics.items()
-                     if np.ndim(v) == 0}
-          scalars['global_step/sec'] = 1.0 / max(
-              dt / max(steps_since_log, 1), 1e-9)
-          scalars['examples/sec'] = examples_per_sec
-          writer.write_scalars(step_i, scalars)
-          writer.flush()
-        t_last = time.time()
-        steps_since_log = 0
-      if step_i % self.save_checkpoints_steps == 0:
-        self.save_checkpoint(state)
-      for hook in hooks:
-        hook.after_step(self, state, step_i, metrics)
-      if step_i < max_train_steps:
-        batch = next(iterator)
-    if self._profiling:
-      jax.profiler.stop_trace()
-      self._profiling = False
+    rollback_budget = self._nan_rollback_budget
+    host_nan_check = self._nan_policy in ('raise', 'rollback')
+    completed = False
+    with graceful_shutdown() as shutdown:
+      try:
+        while step_i < max_train_steps:
+          self._maybe_profile(step_i)
+          features, labels = batch
+          device_batch = self._put_batch(
+              {'features': features.to_dict(),
+               'labels': labels.to_dict() if labels is not None else None})
+          force_nan = np.asarray(
+              fault_injection.fires(fault_injection.SITE_STEP_NAN))
+          state, metrics = step_fn(state, device_batch['features'],
+                                   device_batch['labels'], base_rng,
+                                   force_nan)
+          step_i += 1
+          steps_since_log += 1
+          # The sentinel also fires on every step that is about to be
+          # checkpointed (periodic or final): with nan_check_every_n_steps
+          # > 1 an unvetted save could otherwise commit NaN params, and a
+          # later rollback would restore the poison.
+          if host_nan_check and (
+              step_i % self._nan_check_every_n_steps == 0
+              or step_i % self.save_checkpoints_steps == 0
+              or step_i == max_train_steps):
+            state, step_i, rolled_back = self._check_finite_loss(
+                state, metrics, step_i, rollback_budget)
+            if rolled_back:
+              rollback_budget -= 1
+              steps_since_log = 0
+              t_last = time.time()
+              batch = next(iterator)
+              continue
+          if step_i % self.log_every_n_steps == 0 or step_i == max_train_steps:
+            metrics = jax.device_get(dict(metrics))
+            dt = time.time() - t_last
+            examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
+            self._throughput = (examples_per_sec, dt / max(steps_since_log, 1))
+            _log('step %d: loss=%s (%.1f examples/sec)', step_i,
+                 metrics.get('loss'), examples_per_sec)
+            writer = self.train_metrics_writer
+            if writer is not None:
+              scalars = {k: float(np.mean(v)) for k, v in metrics.items()
+                         if np.ndim(v) == 0}
+              scalars['global_step/sec'] = 1.0 / max(
+                  dt / max(steps_since_log, 1), 1e-9)
+              scalars['examples/sec'] = examples_per_sec
+              # Corrupt-record quarantine counters (reliability/quarantine):
+              # dirty data is tolerated within budget but never invisible.
+              scalars.update(quarantine_lib.aggregate_metrics())
+              writer.write_scalars(step_i, scalars)
+              writer.flush()
+            t_last = time.time()
+            steps_since_log = 0
+          if step_i % self.save_checkpoints_steps == 0:
+            self.save_checkpoint(state)
+          for hook in hooks:
+            hook.after_step(self, state, step_i, metrics)
+          if shutdown.requested:
+            # Commit everything before re-raising: the restart resumes
+            # from this exact step instead of the last periodic save.
+            self.save_checkpoint(state, force=True)
+            self.checkpoint_manager.wait_until_finished()
+            raise TrainingPreempted(shutdown.signum, step_i)
+          if step_i < max_train_steps:
+            batch = next(iterator)
+        completed = True
+      finally:
+        # A dangling profiler trace breaks the next start_trace: stop it
+        # on EVERY exit path, not only clean completion.
+        if self._profiling:
+          try:
+            jax.profiler.stop_trace()
+          except Exception as e:  # noqa: BLE001 — already unwinding
+            _log('Profiler stop on failure path failed: %s', e)
+          self._profiling = False
+          self._profile_steps = None
+        if not completed:
+          # NonFiniteLossError means ``state`` holds the NaN-poisoned
+          # update ('raise', or 'rollback' with the budget exhausted) —
+          # committing it would make the poison the newest checkpoint
+          # and wedge every restart. Flush writers only in that case.
+          poisoned = isinstance(sys.exc_info()[1], NonFiniteLossError)
+          self._flush_and_emergency_save(state, skip_save=poisoned)
     self.save_checkpoint(state, force=True)
     for hook in hooks:
       hook.end(self, state)
     return state
+
+  def _check_finite_loss(self, state, metrics, step_i: int,
+                         rollback_budget: int):
+    """Host-side non-finite-loss sentinel for 'raise'/'rollback'.
+
+    Returns (state, step_i, rolled_back). Forces a device sync (the cost
+    documented on ``nan_check_every_n_steps``).
+    """
+    loss = metrics.get('loss') if hasattr(metrics, 'get') else None
+    if loss is None:
+      return state, step_i, False
+    loss_val = np.asarray(jax.device_get(loss))
+    if np.all(np.isfinite(loss_val)):
+      return state, step_i, False
+    if self._nan_policy == 'raise':
+      raise NonFiniteLossError(step_i, 'nan_policy="raise"')
+    if rollback_budget <= 0:
+      raise NonFiniteLossError(
+          step_i, 'rollback budget exhausted after {} rollback(s)'.format(
+              self._nan_rollback_budget))
+    try:
+      self.checkpoint_manager.wait_until_finished()
+      self.checkpoint_manager.reload()
+      latest = self.checkpoint_manager.latest_step()
+      if latest is None:
+        raise NonFiniteLossError(
+            step_i, 'no committed checkpoint to roll back to')
+      _log('Non-finite loss at step %d: rolling back to checkpoint %d '
+           '(%d rollback(s) left).', step_i, latest, rollback_budget - 1)
+      # The current (poisoned but shape-valid) state doubles as the
+      # restore template: same tree, dtypes, and shardings.
+      restored = self.checkpoint_manager.restore(state, step=latest)
+    except NonFiniteLossError:
+      raise
+    except Exception as e:
+      # A rollback that fails for ANY reason must still unwind as
+      # NonFiniteLossError: the finally-block emergency save keys on that
+      # type to know ``state`` is poisoned and must not be committed.
+      raise NonFiniteLossError(
+          step_i, 'rollback failed: {}'.format(e)) from e
+    return restored, int(latest), True
+
+  def _flush_and_emergency_save(self, state, skip_save: bool = False) -> None:
+    """Failure-path cleanup: commit the state we have, flush writers.
+
+    Best-effort by design — the original exception is already unwinding
+    and must stay the one the caller sees. (If the failure happened
+    inside the jitted step, ``state`` may hold donated buffers; the save
+    then fails and is logged, never raised.) ``skip_save`` suppresses the
+    checkpoint when the state is known-poisoned (non-finite loss).
+    """
+    if not skip_save:
+      try:
+        self.save_checkpoint(state, force=True)
+        self.checkpoint_manager.wait_until_finished()
+      except Exception as e:  # noqa: BLE001
+        _log('Emergency checkpoint failed: %s', e)
+    for writer in (self._train_writer, self._eval_writer):
+      if writer is not None:
+        try:
+          writer.flush()
+        except Exception as e:  # noqa: BLE001
+          _log('Writer flush on failure path failed: %s', e)
 
   def evaluate(self,
                input_generator: AbstractInputGenerator,
@@ -500,6 +692,31 @@ class Trainer:
 
   def save_checkpoint(self, state: TrainState, force: bool = False) -> None:
     step = int(jax.device_get(state.step))
+    # Settle our own in-flight async save first: reload() replaces orbax's
+    # cached step list (which includes in-flight saves) with the on-disk
+    # view (which does not), so reloading mid-commit would let the dedupe
+    # below miss our own save and race it. This wait is also where a
+    # transient failure of the PREVIOUS async commit surfaces — absorb it
+    # (one lost intermediate checkpoint, logged) and let this save commit
+    # the current, newer state instead of killing the run.
+    try:
+      self.checkpoint_manager.wait_until_finished()
+      self._async_commit_failures = 0
+    except Exception as e:  # noqa: BLE001 — async commit of an older step
+      self._async_commit_failures = getattr(
+          self, '_async_commit_failures', 0) + 1
+      if self._async_commit_failures >= 3:
+        # The filesystem is not blipping, it is down: losing every
+        # intermediate checkpoint silently is worse than failing the run.
+        raise
+      _log('Async commit of a previous checkpoint failed (%s); '
+           'continuing with the save of step %d (%d consecutive '
+           'failure(s) tolerated before raising).', e, step,
+           self._async_commit_failures)
+    # Re-read disk before the dedupe check: a concurrent trainer (or a
+    # previous incarnation of this one, pre-preemption) may have committed
+    # this step already — re-saving would race its commit.
+    self.checkpoint_manager.reload()
     if step in self.checkpoint_manager.all_steps():
       return
     if self.checkpoint_manager.save(step, state, force=force):
@@ -590,7 +807,10 @@ def train_eval_model(t2r_model: AbstractT2RModel,
       async_checkpoints=async_checkpoints,
       write_metrics=write_metrics,
       eval_name=eval_name,
-      profile_steps=profile_steps)
+      profile_steps=profile_steps,
+      # An eval-only job reads checkpoints a separate trainer process is
+      # writing: it must never rename (quarantine) step dirs there.
+      owns_checkpoint_dir=input_generator_train is not None)
   _maybe_snapshot_config(model_dir)
 
   hooks: List[Any] = []
@@ -624,8 +844,20 @@ def train_eval_model(t2r_model: AbstractT2RModel,
     elif input_generator_eval is not None:
       for step in checkpointing.checkpoints_iterator(
           model_dir, timeout_secs=eval_timeout_secs):
-        # state=None: evaluate re-restores the newest checkpoint itself.
-        eval_metrics = trainer.evaluate(input_generator_eval, eval_steps)
+        try:
+          # state=None: evaluate re-restores the newest checkpoint itself
+          # (falling back to an older committed step when the newest is
+          # half-written or GC'd, Trainer.init_state).
+          eval_metrics = trainer.evaluate(input_generator_eval, eval_steps)
+        except CHECKPOINT_SKIP_ERRORS as e:
+          # No committed step was restorable right now — a concurrent
+          # trainer may still be mid-commit; keep polling instead of
+          # dying. The narrow tuple matters: a data-layer OSError from
+          # the eval pipeline itself (missing dataset, corruption budget)
+          # is NOT a checkpoint problem and propagates.
+          _log('Continuous eval: checkpoint %d unrestorable (%s); '
+               'skipping.', step, e)
+          continue
         _log('continuous eval @ ckpt %d: %s', step, eval_metrics)
         state = trainer.last_eval_state
         _run_exporters(state, eval_metrics)

@@ -20,4 +20,24 @@ os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL', '2')
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
-jax.config.update('jax_num_cpu_devices', 8)
+try:
+  jax.config.update('jax_num_cpu_devices', 8)
+except AttributeError:
+  # Older jax (e.g. 0.4.x) has no jax_num_cpu_devices option; request the
+  # 8 virtual devices through XLA_FLAGS instead. The env var is read when
+  # the CPU client is created — after this conftest runs, even though
+  # sitecustomize already imported jax — and is only set on THIS branch
+  # because newer jax rejects having both knobs set at once.
+  _flags = os.environ.get('XLA_FLAGS', '')
+  if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+
+def pytest_configure(config):
+  config.addinivalue_line(
+      'markers', 'slow: long-running tests excluded from the tier-1 run')
+  config.addinivalue_line(
+      'markers',
+      'fault: FaultInjector-driven fault-tolerance tests '
+      "(kept inside the tier-1 'not slow' selection; filter with -m fault)")

@@ -1,0 +1,609 @@
+"""Fault-path coverage: the FaultInjector driven through every recovery
+path the reliability layer promises (docs/reliability.md) — corrupt-record
+skip and budget exhaustion, NaN skip vs. rollback, retrying checkpoint
+save/restore, preemption checkpoints, and continuous eval surviving a
+damaged checkpoint.
+"""
+
+import os
+import shutil
+import signal
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.data import (
+    DefaultRecordInputGenerator,
+    TFRecordWriter,
+    build_example,
+    tfrecord_iterator,
+)
+from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.reliability import (
+    CorruptionBudgetExceeded,
+    CorruptRecordError,
+    FaultInjector,
+    InjectedFault,
+    NonFiniteLossError,
+    RecordQuarantine,
+    RetryError,
+    RetryPolicy,
+    TrainingPreempted,
+    configure_fault_injector,
+    fault_injection,
+    quarantine as quarantine_lib,
+    retry,
+    set_injector,
+)
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.trainer import (
+    CheckpointManager,
+    Trainer,
+    latest_checkpoint_step,
+    train_eval_model,
+)
+from tensor2robot_tpu.utils.mocks import MockInputGenerator, MockT2RModel
+
+# A zero-sleep, zero-jitter policy so injected-fault tests never wait.
+FAST_RETRY = RetryPolicy(max_attempts=3, base_delay_secs=0.0, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_reliability_state():
+  set_injector(None)
+  quarantine_lib.reset_aggregate_metrics()
+  yield
+  set_injector(None)
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+  return str(tmp_path / 'run')
+
+
+# -- retry primitive ---------------------------------------------------------
+
+
+class TestRetry:
+
+  def test_returns_after_transient_failures(self):
+    calls = []
+
+    def flaky():
+      calls.append(1)
+      if len(calls) < 3:
+        raise OSError('transient')
+      return 'ok'
+
+    assert retry(flaky, FAST_RETRY, sleep=lambda _: None) == 'ok'
+    assert len(calls) == 3
+
+  def test_exhaustion_raises_retry_error_with_cause(self):
+    def always_fails():
+      raise OSError('still down')
+
+    with pytest.raises(RetryError) as excinfo:
+      retry(always_fails, FAST_RETRY, site='ckpt.save',
+            sleep=lambda _: None)
+    assert excinfo.value.attempts == 3
+    assert isinstance(excinfo.value.last, OSError)
+    assert 'ckpt.save' in str(excinfo.value)
+
+  def test_non_retryable_propagates_immediately(self):
+    calls = []
+
+    def broken():
+      calls.append(1)
+      raise ValueError('deterministic bug')
+
+    with pytest.raises(ValueError):
+      retry(broken, FAST_RETRY, sleep=lambda _: None)
+    assert len(calls) == 1
+
+  def test_backoff_schedule(self):
+    delays = []
+    policy = RetryPolicy(max_attempts=4, base_delay_secs=0.1, backoff=2.0,
+                         max_delay_secs=0.3, jitter=0.0)
+
+    def always_fails():
+      raise OSError('x')
+
+    with pytest.raises(RetryError):
+      retry(always_fails, policy, sleep=delays.append)
+    np.testing.assert_allclose(delays, [0.1, 0.2, 0.3])
+
+
+# -- fault injector ----------------------------------------------------------
+
+
+class TestFaultInjector:
+
+  def test_deterministic_by_call_index(self):
+    injector = FaultInjector().fail('site', times=2, after=1)
+    fired = [injector.fires('site') for _ in range(5)]
+    assert fired == [False, True, True, False, False]
+    assert injector.call_count('site') == 5
+    assert injector.fired_count('site') == 2
+
+  def test_maybe_fail_raises_injected_fault(self):
+    injector = FaultInjector().fail('site')
+    with pytest.raises(InjectedFault):
+      injector.maybe_fail('site')
+    injector.maybe_fail('site')  # second call: disarmed
+
+  def test_injected_fault_is_transient_io(self):
+    # The default retry policy must classify injected faults as the
+    # transient I/O errors they simulate.
+    assert issubclass(InjectedFault, IOError)
+
+  def test_configure_from_spec(self):
+    injector = configure_fault_injector({'ckpt.save': 2})
+    assert fault_injection.get_injector() is injector
+    assert injector.fires('ckpt.save') and injector.fires('ckpt.save')
+    assert not injector.fires('ckpt.save')
+    configure_fault_injector(None)
+    assert fault_injection.get_injector() is None
+
+  def test_configure_with_after_offsets(self):
+    injector = configure_fault_injector([('data.read', 1, 2)])
+    assert [injector.fires('data.read') for _ in range(4)] == [
+        False, False, True, False]
+
+
+# -- corrupt-record quarantine ----------------------------------------------
+
+
+def _write_records(path, values):
+  with TFRecordWriter(path) as writer:
+    for v in values:
+      writer.write(build_example({'x': np.asarray([float(v)], np.float32)}))
+
+
+def _corrupt_record_payload(path, record_index):
+  """Flips one payload byte of record ``record_index`` (framing intact)."""
+  with open(path, 'rb') as f:
+    blob = bytearray(f.read())
+  offset = 0
+  for _ in range(record_index):
+    (length,) = struct.unpack('<Q', blob[offset:offset + 8])
+    offset += 12 + length + 4
+  (length,) = struct.unpack('<Q', blob[offset:offset + 8])
+  payload_at = offset + 12 + length // 2
+  blob[payload_at] ^= 0xFF
+  with open(path, 'wb') as f:
+    f.write(bytes(blob))
+
+
+def _corrupt_record_length(path, record_index):
+  """Flips a byte of the length CRC of record ``record_index``."""
+  with open(path, 'rb') as f:
+    blob = bytearray(f.read())
+  offset = 0
+  for _ in range(record_index):
+    (length,) = struct.unpack('<Q', blob[offset:offset + 8])
+    offset += 12 + length + 4
+  blob[offset + 8] ^= 0xFF
+  with open(path, 'wb') as f:
+    f.write(bytes(blob))
+
+
+@pytest.mark.fault
+class TestCorruptRecordQuarantine:
+
+  def test_corruption_raises_without_skip_mode(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(5))
+    _corrupt_record_payload(path, 2)
+    with pytest.raises(CorruptRecordError, match='data CRC'):
+      list(tfrecord_iterator(path, verify_crc=True))
+
+  def test_skip_mode_drops_only_the_bad_record(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(5))
+    _corrupt_record_payload(path, 2)
+    quarantine = RecordQuarantine()
+    records = list(tfrecord_iterator(path, verify_crc=True,
+                                     skip_corrupt=True,
+                                     quarantine=quarantine))
+    assert len(records) == 4
+    assert quarantine.records_skipped == 1
+    assert quarantine.skipped_in_file(path) == 1
+    assert quarantine.files_abandoned == 0
+
+  def test_length_corruption_abandons_rest_of_file(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(5))
+    _corrupt_record_length(path, 2)
+    quarantine = RecordQuarantine()
+    records = list(tfrecord_iterator(path, verify_crc=True,
+                                     skip_corrupt=True,
+                                     quarantine=quarantine))
+    # Records 0-1 stream out; the framing is untrustworthy from record 2 on.
+    assert len(records) == 2
+    assert quarantine.files_abandoned == 1
+
+  def test_truncated_file_is_quarantined_not_fatal(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(3))
+    size = os.path.getsize(path)
+    with open(path, 'rb+') as f:
+      f.truncate(size - 6)  # chop into the last record's frame
+    quarantine = RecordQuarantine()
+    records = list(tfrecord_iterator(path, verify_crc=True,
+                                     skip_corrupt=True,
+                                     quarantine=quarantine))
+    assert len(records) == 2
+    assert quarantine.files_abandoned == 1
+
+  def test_per_file_budget_exhaustion_names_file(self, tmp_path):
+    path = str(tmp_path / 'dirty.tfrecord')
+    _write_records(path, range(6))
+    for index in (1, 3):
+      _corrupt_record_payload(path, index)
+    quarantine = RecordQuarantine(max_corrupt_records_per_file=1)
+    with pytest.raises(CorruptionBudgetExceeded) as excinfo:
+      list(tfrecord_iterator(path, verify_crc=True, skip_corrupt=True,
+                             quarantine=quarantine))
+    assert 'dirty.tfrecord' in str(excinfo.value)
+    assert excinfo.value.path == path
+
+  def test_global_budget_spans_files(self, tmp_path):
+    paths = []
+    for i in range(3):
+      path = str(tmp_path / 'shard-{}.tfrecord'.format(i))
+      _write_records(path, range(4))
+      _corrupt_record_payload(path, 1)
+      paths.append(path)
+    quarantine = RecordQuarantine(max_corrupt_records=2,
+                                  max_corrupt_records_per_file=10)
+    with pytest.raises(CorruptionBudgetExceeded):
+      for path in paths:
+        list(tfrecord_iterator(path, verify_crc=True, skip_corrupt=True,
+                               quarantine=quarantine))
+
+  def test_injector_data_read_is_a_corrupt_record(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(5))
+    set_injector(FaultInjector().fail('data.read', times=1, after=2))
+    quarantine = RecordQuarantine()
+    records = list(tfrecord_iterator(path, verify_crc=True,
+                                     skip_corrupt=True,
+                                     quarantine=quarantine))
+    assert len(records) == 4
+    assert quarantine.records_skipped == 1
+
+  def test_stream_through_generator_skips_and_counts(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(10))
+    _corrupt_record_payload(path, 4)
+    fs = SpecStruct(x=TensorSpec((1,), np.float32, name='x'))
+    gen = DefaultRecordInputGenerator(file_patterns=path, batch_size=3,
+                                      skip_corrupt_records=True)
+    gen.set_specification(fs, SpecStruct())
+    batches = list(gen.create_dataset_iterator('eval', num_epochs=1))
+    assert len(batches) == 3  # 9 surviving records / batch 3
+    assert gen.quarantine.records_skipped == 1
+    metrics = quarantine_lib.aggregate_metrics()
+    assert metrics['data/corrupt_records_skipped'] == 1.0
+
+  def test_skip_mode_rejects_forced_native_path(self, tmp_path):
+    path = str(tmp_path / 'data.tfrecord')
+    _write_records(path, range(4))
+    with pytest.raises(ValueError, match='skip_corrupt_records'):
+      gen = DefaultRecordInputGenerator(
+          file_patterns=path, batch_size=2, use_native=True,
+          skip_corrupt_records=True)
+      gen.set_specification(
+          SpecStruct(x=TensorSpec((1,), np.float32, name='x')), SpecStruct())
+      gen.create_dataset_iterator('eval', num_epochs=1)
+
+
+# -- NaN sentinel -------------------------------------------------------------
+
+
+@pytest.mark.fault
+class TestNanPolicies:
+
+  def _train(self, model_dir, nan_policy, max_train_steps=6, **kwargs):
+    model = MockT2RModel(use_batch_norm=False)
+    generator = MockInputGenerator(batch_size=8)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=kwargs.pop(
+                          'save_checkpoints_steps', 2),
+                      log_every_n_steps=100,
+                      nan_policy=nan_policy, **kwargs)
+    try:
+      state = trainer.train(generator, max_train_steps=max_train_steps)
+    finally:
+      trainer.close()
+    return trainer, state
+
+  def test_skip_discards_poisoned_update_and_finishes(self, model_dir):
+    injector = FaultInjector().fail('step.nan', times=1, after=2)
+    set_injector(injector)
+    trainer, state = self._train(model_dir, 'skip')
+    assert injector.fired_count('step.nan') == 1
+    assert int(jax.device_get(state.step)) == 6
+    params = jax.device_get(state.params)
+    assert all(np.all(np.isfinite(leaf)) for leaf in jax.tree.leaves(params))
+    assert latest_checkpoint_step(model_dir) == 6
+
+  def test_raise_policy_fails_fast(self, model_dir):
+    set_injector(FaultInjector().fail('step.nan', times=1, after=2))
+    with pytest.raises(NonFiniteLossError):
+      self._train(model_dir, 'raise')
+
+  def test_rollback_restores_last_checkpoint_and_finishes(self, model_dir):
+    injector = FaultInjector().fail('step.nan', times=1, after=4)
+    set_injector(injector)
+    trainer, state = self._train(model_dir, 'rollback',
+                                 save_checkpoints_steps=2)
+    assert injector.fired_count('step.nan') == 1
+    # Rolled back to the step-4 checkpoint, then re-ran to completion.
+    assert int(jax.device_get(state.step)) == 6
+    assert latest_checkpoint_step(model_dir) == 6
+
+  def test_rollback_budget_exhaustion_raises(self, model_dir):
+    # Every re-done step injects again, so the budget must run out.
+    set_injector(FaultInjector().fail('step.nan', times=1000, after=4))
+    with pytest.raises(NonFiniteLossError, match='budget'):
+      self._train(model_dir, 'rollback', nan_rollback_budget=2)
+
+
+# -- retrying checkpoint I/O --------------------------------------------------
+
+
+@pytest.mark.fault
+class TestCheckpointRetry:
+
+  def test_save_retries_past_transient_failures(self, model_dir):
+    injector = FaultInjector().fail('ckpt.save', times=2)
+    set_injector(injector)
+    manager = CheckpointManager(model_dir, async_checkpoints=False,
+                                retry_policy=FAST_RETRY)
+    try:
+      assert manager.save(1, {'a': np.arange(4.0)}, force=True)
+      manager.wait_until_finished()
+    finally:
+      manager.close()
+    assert injector.fired_count('ckpt.save') == 2
+    assert latest_checkpoint_step(model_dir) == 1
+
+  def test_save_exhaustion_raises_retry_error(self, model_dir):
+    set_injector(FaultInjector().fail('ckpt.save', times=10))
+    manager = CheckpointManager(model_dir, async_checkpoints=False,
+                                retry_policy=FAST_RETRY)
+    try:
+      with pytest.raises(RetryError):
+        manager.save(1, {'a': np.arange(4.0)}, force=True)
+    finally:
+      manager.close()
+
+  def test_restore_retries_past_transient_failures(self, model_dir):
+    manager = CheckpointManager(model_dir, async_checkpoints=False,
+                                retry_policy=FAST_RETRY)
+    try:
+      manager.save(1, {'a': np.arange(4.0)}, force=True)
+      manager.wait_until_finished()
+      injector = FaultInjector().fail('ckpt.restore', times=2)
+      set_injector(injector)
+      restored = manager.restore({'a': np.zeros(4)}, step=1)
+    finally:
+      manager.close()
+    assert injector.fired_count('ckpt.restore') == 2
+    np.testing.assert_allclose(restored['a'], np.arange(4.0))
+
+
+# -- preemption + failure-path cleanup ---------------------------------------
+
+
+class _SignalAtStep:
+  """Hook that delivers a real SIGTERM to this process at one step."""
+
+  def __init__(self, step):
+    self._step = step
+
+  def begin(self, trainer):
+    pass
+
+  def after_step(self, trainer, state, step_i, metrics):
+    if step_i == self._step:
+      os.kill(os.getpid(), signal.SIGTERM)
+
+  def end(self, trainer, state):
+    pass
+
+
+class _RaiseAtStep:
+
+  def __init__(self, step, exc):
+    self._step = step
+    self._exc = exc
+
+  def begin(self, trainer):
+    pass
+
+  def after_step(self, trainer, state, step_i, metrics):
+    if step_i == self._step:
+      raise self._exc
+
+  def end(self, trainer, state):
+    pass
+
+
+@pytest.mark.fault
+class TestPreemptionAndCleanup:
+
+  def test_sigterm_commits_emergency_checkpoint(self, model_dir):
+    model = MockT2RModel(use_batch_norm=False)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=100)
+    with pytest.raises(TrainingPreempted) as excinfo:
+      trainer.train(MockInputGenerator(batch_size=8), max_train_steps=50,
+                    hooks=[_SignalAtStep(3)])
+    trainer.close()
+    assert excinfo.value.signum == signal.SIGTERM
+    # Everything up to the preemption point was committed...
+    assert latest_checkpoint_step(model_dir) == 3
+    # ...and a fresh trainer resumes from it.
+    model2 = MockT2RModel(use_batch_norm=False)
+    trainer2 = Trainer(model2, model_dir, async_checkpoints=False,
+                       save_checkpoints_steps=10**9)
+    state = trainer2.train(MockInputGenerator(batch_size=8),
+                           max_train_steps=5)
+    trainer2.close()
+    assert int(jax.device_get(state.step)) == 5
+
+  def test_midloop_exception_saves_and_stops_profiler(self, model_dir):
+    model = MockT2RModel(use_batch_norm=False)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=10**9, log_every_n_steps=100,
+                      profile_steps=(0, 10**9))
+    with pytest.raises(RuntimeError, match='boom'):
+      trainer.train(MockInputGenerator(batch_size=8), max_train_steps=50,
+                    hooks=[_RaiseAtStep(3, RuntimeError('boom'))])
+    # The active trace was stopped on the failure path — a dangling trace
+    # would make the next start_trace raise.
+    assert not trainer._profiling
+    trainer.close()
+    assert latest_checkpoint_step(model_dir) == 3
+
+
+# -- continuous eval vs. damaged checkpoints ---------------------------------
+
+
+@pytest.mark.fault
+class TestContinuousEvalSurvival:
+
+  def _pretrain(self, model_dir, steps=6):
+    model = MockT2RModel(use_batch_norm=False)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=3, log_every_n_steps=100)
+    trainer.train(MockInputGenerator(batch_size=8), max_train_steps=steps)
+    trainer.close()
+
+  def test_init_state_falls_back_past_injected_failures(self, model_dir):
+    self._pretrain(model_dir)  # checkpoints at 3 and 6
+    # Exhaust the retry budget on the newest step; the fallback must land
+    # on the older committed one.
+    set_injector(FaultInjector().fail('ckpt.restore', times=3))
+    model = MockT2RModel(use_batch_norm=False)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      log_every_n_steps=100)
+    trainer.checkpoint_manager._retry_policy = FAST_RETRY
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(
+        generator.create_dataset_iterator(mode=ModeKeys.TRAIN))
+    state = trainer.init_state(features, labels)
+    trainer.close()
+    assert int(jax.device_get(state.step)) == 3
+
+  def test_init_state_falls_back_past_gutted_step_dir(self, model_dir):
+    self._pretrain(model_dir)  # checkpoints at 3 and 6
+    step_dir = os.path.join(model_dir, 'checkpoints', '6')
+    for name in os.listdir(step_dir):
+      full = os.path.join(step_dir, name)
+      shutil.rmtree(full) if os.path.isdir(full) else os.remove(full)
+    model = MockT2RModel(use_batch_norm=False)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      log_every_n_steps=100)
+    trainer.checkpoint_manager._retry_policy = FAST_RETRY
+    generator = MockInputGenerator(batch_size=8)
+    generator.set_specification_from_model(model, ModeKeys.TRAIN)
+    features, labels = next(
+        generator.create_dataset_iterator(mode=ModeKeys.TRAIN))
+    state = trainer.init_state(features, labels)
+    trainer.close()
+    assert int(jax.device_get(state.step)) == 3
+
+  def test_predictor_falls_back_to_older_intact_step(self, model_dir):
+    from tensor2robot_tpu.predictors.checkpoint_predictor import (
+        CheckpointPredictor,
+    )
+    self._pretrain(model_dir)  # checkpoints at 3 and 6
+    step_dir = os.path.join(model_dir, 'checkpoints', '6')
+    for name in os.listdir(step_dir):
+      full = os.path.join(step_dir, name)
+      shutil.rmtree(full) if os.path.isdir(full) else os.remove(full)
+    model = MockT2RModel(use_batch_norm=False)
+    predictor = CheckpointPredictor(model, checkpoint_dir=model_dir,
+                                    timeout=10)
+    assert predictor.restore()
+    # Served from the older intact step; the damaged dir was NOT renamed
+    # (read-only consumers never mutate a training directory).
+    assert predictor.global_step == 3
+    assert os.path.isdir(step_dir)
+    predictor.close()
+
+  def test_continuous_eval_survives_damaged_newest(self, model_dir):
+    self._pretrain(model_dir)  # checkpoints at 3 and 6
+    step_dir = os.path.join(model_dir, 'checkpoints', '6')
+    for name in os.listdir(step_dir):
+      full = os.path.join(step_dir, name)
+      shutil.rmtree(full) if os.path.isdir(full) else os.remove(full)
+    model = MockT2RModel(use_batch_norm=False)
+    result = train_eval_model(
+        model, model_dir,
+        input_generator_eval=MockInputGenerator(batch_size=8),
+        eval_steps=2, eval_timeout_secs=1.0, async_checkpoints=False)
+    assert 'loss' in result['eval_metrics']
+
+
+# -- acceptance: one run, three faults ---------------------------------------
+
+
+@pytest.mark.fault
+class TestSingleRunSurvivesAllFaults:
+
+  def test_corrupt_record_nan_and_save_failure_in_one_run(
+      self, model_dir, tmp_path):
+    """ISSUE acceptance: one injected corrupt record + one injected NaN
+    loss + one injected checkpoint-save failure in a single run, which
+    still reaches max_train_steps with a committed final checkpoint."""
+    path = str(tmp_path / 'train.tfrecord')
+    with TFRecordWriter(path) as writer:
+      rng = np.random.RandomState(0)
+      for _ in range(64):
+        state_vec = rng.rand(8).astype(np.float32)
+        writer.write(build_example({
+            'measured_position': state_vec,
+            'valid_position': np.asarray(
+                [float(state_vec.mean() > 0.5)], np.float32),
+        }))
+    set_injector(FaultInjector()
+                 .fail('data.read', times=1, after=5)
+                 .fail('step.nan', times=1, after=2)
+                 .fail('ckpt.save', times=1, after=1))
+    model = MockT2RModel(use_batch_norm=False)
+    generator = DefaultRecordInputGenerator(
+        file_patterns=path, batch_size=8, skip_corrupt_records=True,
+        shuffle_buffer_size=8)
+    trainer = Trainer(model, model_dir, async_checkpoints=False,
+                      save_checkpoints_steps=2, log_every_n_steps=2,
+                      nan_policy='skip')
+    state = trainer.train(generator, max_train_steps=6)
+    trainer.close()
+    injector = fault_injection.get_injector()
+    assert injector.fired_count('data.read') == 1
+    assert injector.fired_count('step.nan') == 1
+    assert injector.fired_count('ckpt.save') == 1
+    assert int(jax.device_get(state.step)) == 6
+    assert latest_checkpoint_step(model_dir) == 6
+    metrics = quarantine_lib.aggregate_metrics()
+    assert metrics['data/corrupt_records_skipped'] == 1.0
+
+  def test_budget_exhaustion_fails_loudly_with_filename(
+      self, model_dir, tmp_path):
+    path = str(tmp_path / 'hopeless.tfrecord')
+    _write_records(path, range(32))
+    set_injector(FaultInjector().fail('data.read', times=1000))
+    generator = DefaultRecordInputGenerator(
+        file_patterns=path, batch_size=4, skip_corrupt_records=True,
+        max_corrupt_records_per_file=3)
+    generator.set_specification(
+        SpecStruct(x=TensorSpec((1,), np.float32, name='x')), SpecStruct())
+    with pytest.raises(CorruptionBudgetExceeded, match='hopeless.tfrecord'):
+      list(generator.create_dataset_iterator('eval', num_epochs=1))
