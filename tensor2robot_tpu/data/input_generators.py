@@ -33,26 +33,42 @@ from tensor2robot_tpu.data.pipeline import (
 from tensor2robot_tpu.modes import ModeKeys, assert_valid_mode
 
 
-def prefetch_iterator(iterator: Iterator, depth: int) -> Iterator:
+def prefetch_iterator(iterator: Iterator, depth: int,
+                      label: str = 'default') -> Iterator:
   """Wraps an iterator with a ``depth``-deep background prefetch queue.
 
   Producer uses timed puts against a stop event (same discipline as
   BatchedExampleStream, data/pipeline.py): when the consumer abandons or
   closes the generator, the worker thread exits instead of blocking in
   q.put forever holding decoded batches and open readers.
+
+  ``label`` names this queue's telemetry series (the generators pass the
+  mode), so a train and an eval queue in one process report separately.
   """
   import queue
   import threading
+
+  from tensor2robot_tpu.observability import get_registry
 
   q: 'queue.Queue' = queue.Queue(maxsize=depth)
   sentinel = object()
   error: list = []
   stop = threading.Event()
+  # Resolved once per iterator; the per-batch path only bumps them. The
+  # gauge reads near zero when the trainer outruns the pipeline (data-
+  # starved — matches a high goodput 'data' fraction) and near ``depth``
+  # when decode comfortably leads the device.
+  registry = get_registry()
+  prefetched = registry.counter_family(
+      'data/batches_prefetched', ('queue',)).series(label)
+  queue_depth = registry.gauge_family(
+      'data/prefetch_queue_depth', ('queue',)).series(label)
 
   def _put(item) -> bool:
     while not stop.is_set():
       try:
         q.put(item, timeout=0.1)
+        queue_depth.set(q.qsize())
         return True
       except queue.Full:
         continue
@@ -61,12 +77,16 @@ def prefetch_iterator(iterator: Iterator, depth: int) -> Iterator:
   def _producer():
     try:
       for item in iterator:
+        prefetched.inc()
         if not _put(item):
           return
     except BaseException as e:  # surfaced on the consumer side
       error.append(e)
     finally:
       _put(sentinel)
+      # A finished/abandoned queue must not advertise its last depth
+      # forever: stale nonzero depth reads as a healthy full pipeline.
+      queue_depth.set(0)
 
   thread = threading.Thread(target=_producer, daemon=True,
                             name='t2r-prefetch')
@@ -170,7 +190,7 @@ class AbstractInputGenerator(abc.ABC):
                                      num_shards=num_shards, seed=seed)
     depth = self._prefetch if prefetch is None else prefetch
     if depth and depth > 0:
-      iterator = prefetch_iterator(iterator, depth)
+      iterator = prefetch_iterator(iterator, depth, label=mode)
     return iterator
 
   @abc.abstractmethod

@@ -22,6 +22,7 @@ import numpy as np
 
 from tensor2robot_tpu.data import tfrecord
 from tensor2robot_tpu.data.parser import ExampleParser
+from tensor2robot_tpu.observability import get_registry, span
 
 _SUPPORTED_FORMATS = ('tfrecord',)
 
@@ -191,10 +192,13 @@ class BatchedExampleStream:
     for tup in self._record_tuples():
       pending.append(tup)
       if len(pending) == self._batch_size:
-        yield self._parse(pending)
+        with span('data.parse'):
+          batch = self._parse(pending)
+        yield batch
         pending = []
     if pending and not self._drop_remainder:
-      yield self._parse(pending)
+      with span('data.parse'):
+        yield self._parse(pending)
 
   def _parse(self, tuples: List[Dict[str, bytes]]):
     by_key = {key: [t[key] for t in tuples] for key in tuples[0]}
@@ -211,15 +215,27 @@ class BatchedExampleStream:
     sentinel = object()
     error: List[BaseException] = []
     stop = threading.Event()
+    # Resolve instruments once — the per-batch path then only bumps them.
+    # Labeled 'pipeline' to keep this stream's internal queue distinct
+    # from the generators' per-mode prefetch_iterator queues.
+    registry = get_registry()
+    decoded = registry.counter('data/batches_decoded')
+    depth = registry.gauge_family(
+        'data/prefetch_queue_depth', ('queue',)).series('pipeline')
 
     def _worker():
       try:
         for batch in self._batches():
+          decoded.inc()
           # Bounded put so an abandoned consumer lets the worker exit
           # instead of pinning the thread and open file handles forever.
           while not stop.is_set():
             try:
               q.put(batch, timeout=0.1)
+              # Queue depth ~0 under a fast consumer means the host
+              # decode is the bottleneck (the goodput 'data' fraction
+              # names the cost; this gauge names the culprit).
+              depth.set(q.qsize())
               break
             except queue.Full:
               continue
@@ -234,6 +250,9 @@ class BatchedExampleStream:
             break
           except queue.Full:
             continue
+        # Stale nonzero depth from a drained stream reads as a healthy
+        # full queue — zero it when this worker exits.
+        depth.set(0)
 
     thread = threading.Thread(target=_worker, daemon=True)
     thread.start()

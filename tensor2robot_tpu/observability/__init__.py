@@ -1,0 +1,74 @@
+"""Unified telemetry layer: metrics registry, spans, goodput, run files.
+
+The measurement substrate every perf/reliability PR builds on (ISSUE 3):
+
+  * ``TelemetryRegistry`` (`registry.py`) — process-wide, thread-safe
+    counters/gauges/fixed-bucket histograms with labeled series; flat
+    ``scalars()`` for the TensorBoard writer, structured ``snapshot()``
+    (+ ``snapshot_delta``) for jsonl export. ``get_registry()`` is the
+    default instance the built-in layers report to.
+  * ``span`` (`spans.py`) — context-manager/decorator timing regions
+    into ``span/<name>`` histograms and, when a profiler trace window is
+    open (``set_trace_active``), into ``jax.profiler.TraceAnnotation``
+    rows that line up with `utils/xplane.py` captures.
+  * ``GoodputTracker`` (`goodput.py`) — every trainer-loop second
+    charged to productive / data / checkpoint / retry; fractions sum to
+    1.0 by construction.
+  * ``TelemetryLogger`` (`telemetry_file.py`) — append-only
+    ``telemetry.jsonl`` + atomically-replaced ``heartbeat.json`` under
+    ``model_dir``; ``bin/t2r_telemetry`` tails and summarizes them.
+
+Metric name catalog and goodput definitions: docs/observability.md.
+"""
+
+from tensor2robot_tpu.observability.goodput import (
+    CATEGORIES as GOODPUT_CATEGORIES,
+    GoodputTracker,
+)
+from tensor2robot_tpu.observability.registry import (
+    Counter,
+    DEFAULT_LATENCY_BUCKETS_MS,
+    DEFAULT_SECONDS_BUCKETS,
+    Gauge,
+    Histogram,
+    TelemetryRegistry,
+    exponential_buckets,
+    get_registry,
+    set_registry,
+    snapshot_delta,
+)
+from tensor2robot_tpu.observability.spans import (
+    set_trace_active,
+    span,
+    trace_active,
+)
+from tensor2robot_tpu.observability.telemetry_file import (
+    HEARTBEAT_FILENAME,
+    TELEMETRY_FILENAME,
+    TelemetryLogger,
+    read_heartbeat,
+    read_telemetry,
+)
+
+__all__ = [
+    'Counter',
+    'DEFAULT_LATENCY_BUCKETS_MS',
+    'DEFAULT_SECONDS_BUCKETS',
+    'Gauge',
+    'GOODPUT_CATEGORIES',
+    'GoodputTracker',
+    'HEARTBEAT_FILENAME',
+    'Histogram',
+    'TELEMETRY_FILENAME',
+    'TelemetryLogger',
+    'TelemetryRegistry',
+    'exponential_buckets',
+    'get_registry',
+    'read_heartbeat',
+    'read_telemetry',
+    'set_registry',
+    'set_trace_active',
+    'snapshot_delta',
+    'span',
+    'trace_active',
+]

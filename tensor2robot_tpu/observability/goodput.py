@@ -1,0 +1,84 @@
+"""Goodput accounting: where every second of training wall-clock went.
+
+Podracer (arXiv:2104.06272) and the TPUv4 pjit scaling report
+(arXiv:2204.06514) make the same observation: sustained accelerator
+utilization is won by classifying wall time at the seams — an
+unaccounted second is indistinguishable from a slow model. The trainer
+charges every training-loop second to exactly one category:
+
+  * ``productive``  — step dispatch + device compute + everything not
+    claimed below (logging, hooks); the time that trains the model.
+  * ``data``        — waiting on the input pipeline (``next(iterator)``
+    plus host→device transfer). High => data-starved; scale the host
+    pipeline, not the model.
+  * ``checkpoint``  — blocking portions of checkpoint save (async
+    commits only charge their synchronous tail).
+  * ``retry``       — fault-recovery overhead: NaN-rollback restores,
+    retried I/O waits, post-rollback re-fetches.
+
+Because ``productive`` is defined as the remainder, the four categories
+partition wall time exactly: fractions always sum to 1.0 (the invariant
+tests assert). The trainer exports both ``goodput/<cat>_seconds``
+(cumulative) and ``goodput/<cat>_fraction`` to TensorBoard and
+``telemetry.jsonl`` at its log cadence.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ['GoodputTracker', 'PRODUCTIVE', 'DATA', 'CHECKPOINT', 'RETRY',
+           'CATEGORIES']
+
+PRODUCTIVE = 'productive'
+DATA = 'data'
+CHECKPOINT = 'checkpoint'
+RETRY = 'retry'
+
+CATEGORIES = (PRODUCTIVE, DATA, CHECKPOINT, RETRY)
+
+
+class GoodputTracker:
+  """Accumulates seconds per category; reports totals and fractions."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._seconds = {category: 0.0 for category in CATEGORIES}
+
+  def add(self, category: str, seconds: float) -> None:
+    if category not in self._seconds:
+      raise ValueError('Unknown goodput category {!r}; expected one of {}.'
+                       .format(category, CATEGORIES))
+    if seconds < 0:
+      seconds = 0.0  # clock-resolution jitter must not go negative
+    with self._lock:
+      self._seconds[category] += seconds
+
+  def seconds(self) -> Dict[str, float]:
+    with self._lock:
+      return dict(self._seconds)
+
+  def total_seconds(self) -> float:
+    with self._lock:
+      return sum(self._seconds.values())
+
+  def fractions(self) -> Dict[str, float]:
+    """{category: share of accounted wall time}; sums to 1.0 (or all zeros
+    before any time is recorded)."""
+    with self._lock:
+      total = sum(self._seconds.values())
+      if total <= 0.0:
+        return {category: 0.0 for category in CATEGORIES}
+      return {category: value / total
+              for category, value in self._seconds.items()}
+
+  def scalars(self, prefix: str = 'goodput/') -> Dict[str, float]:
+    """The TensorBoard/telemetry export: fractions + cumulative seconds."""
+    out = {}
+    for category, value in self.seconds().items():
+      out['{}{}_seconds'.format(prefix, category)] = value
+    for category, value in self.fractions().items():
+      out['{}{}_fraction'.format(prefix, category)] = value
+    out[prefix + 'total_seconds'] = self.total_seconds()
+    return out

@@ -1,0 +1,106 @@
+"""Trace spans: monotonic wall-time histograms that line up with xplane.
+
+``span('data.next')`` times a region with ``time.perf_counter`` and
+records milliseconds into the registry histogram ``span/data.next``.
+When a ``jax.profiler`` trace window is active (the trainer's
+``profile_steps`` bracket), the span additionally enters a
+``jax.profiler.TraceAnnotation`` of the same name — so the host-side
+seams (data wait, checkpoint save, step dispatch) appear as named rows
+in the SAME capture ``utils/xplane.py`` attributes device ops from, and
+goodput numbers can be cross-checked against the trace.
+
+Outside a trace window the annotation path is skipped entirely (no jax
+import, no TSL call): a span is then two ``perf_counter`` reads and one
+histogram bump. The trainer toggles the window via ``set_trace_active``;
+anything else that starts its own trace can do the same.
+
+Use as a context manager or a decorator::
+
+    with span('data.next'):
+        batch = next(iterator)
+
+    @span('policy.pack')
+    def pack(...): ...
+
+The context-manager form exposes ``elapsed`` (seconds) after exit, so
+call sites that also feed goodput accounting time the region once.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Optional
+
+from tensor2robot_tpu.observability import registry as registry_lib
+
+__all__ = ['span', 'set_trace_active', 'trace_active']
+
+_STATE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False
+
+# Span histograms hold milliseconds: sub-ms histogram bumps up to minutes
+# (a slow checkpoint commit, a cold data pipeline).
+SPAN_BUCKETS_MS = registry_lib.exponential_buckets(0.01, 2.0, 25)
+
+
+def set_trace_active(active: bool) -> None:
+  """Marks a profiler trace window open/closed (trainer._maybe_profile)."""
+  global _TRACE_ACTIVE
+  with _STATE_LOCK:
+    _TRACE_ACTIVE = bool(active)
+
+
+def trace_active() -> bool:
+  return _TRACE_ACTIVE
+
+
+class span:  # noqa: N801 — reads as a keyword at call sites
+  """Times one region into ``span/<name>`` (ms); annotates active traces."""
+
+  __slots__ = ('_name', '_registry', '_start', '_annotation', 'elapsed')
+
+  def __init__(self, name: str,
+               registry: Optional[registry_lib.TelemetryRegistry] = None):
+    self._name = name
+    self._registry = registry
+    self._start = 0.0
+    self._annotation = None
+    self.elapsed = 0.0
+
+  def __enter__(self) -> 'span':
+    if _TRACE_ACTIVE:
+      try:
+        import jax  # deferred: spans must work on jax-free hosts
+
+        self._annotation = jax.profiler.TraceAnnotation(self._name)
+        self._annotation.__enter__()
+      except Exception:  # noqa: BLE001 — annotation is best-effort
+        self._annotation = None
+    self._start = time.perf_counter()
+    return self
+
+  def __exit__(self, exc_type, exc, tb) -> None:
+    self.elapsed = time.perf_counter() - self._start
+    if self._annotation is not None:
+      try:
+        self._annotation.__exit__(exc_type, exc, tb)
+      except Exception:  # noqa: BLE001
+        pass
+      self._annotation = None
+    registry = self._registry or registry_lib.get_registry()
+    registry.histogram('span/' + self._name,
+                       bounds=SPAN_BUCKETS_MS).record(self.elapsed * 1e3)
+
+  def __call__(self, fn):
+    """Decorator form: each call runs under a fresh span instance."""
+    name = self._name
+    registry = self._registry
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+      with span(name, registry=registry):
+        return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,115 @@
+"""Machine-readable run telemetry: ``telemetry.jsonl`` + heartbeat file.
+
+TensorBoard events are for humans with a browser; fleet tooling (and
+``bin/t2r_telemetry``) wants greppable, append-only JSON lines under
+``model_dir``:
+
+  * ``telemetry.jsonl`` — one JSON object per line:
+    ``{"time": <unix>, "kind": "...", "step": <int|null>, ...payload}``.
+    Kinds written by the trainer: ``run_start``, ``train`` (scalars +
+    goodput at the log cadence), ``preempted``, ``rollback``,
+    ``run_abort`` (any other exception escaping the loop), ``run_end``.
+    The file is append-only across restarts — a preempted run's history
+    survives its own resumption.
+  * ``heartbeat.json`` — atomically replaced (tmp + rename) at the log
+    cadence: ``{"time", "step", "pid", "hostname"}``. A watchdog that
+    sees a stale heartbeat knows the process is wedged even when the
+    jsonl tail looks healthy; readers never observe a half-written file.
+
+``read_telemetry`` tolerates a torn final line (the writer may be killed
+mid-append) but raises on malformed interior lines — silent corruption
+of history is worse than a crash in a tool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Dict, List, Optional
+
+__all__ = ['TelemetryLogger', 'read_telemetry', 'read_heartbeat',
+           'TELEMETRY_FILENAME', 'HEARTBEAT_FILENAME']
+
+TELEMETRY_FILENAME = 'telemetry.jsonl'
+HEARTBEAT_FILENAME = 'heartbeat.json'
+
+
+class TelemetryLogger:
+  """Appends telemetry records and maintains the heartbeat for one run."""
+
+  def __init__(self, model_dir: str):
+    os.makedirs(model_dir, exist_ok=True)
+    self.model_dir = model_dir
+    self._path = os.path.join(model_dir, TELEMETRY_FILENAME)
+    self._heartbeat_path = os.path.join(model_dir, HEARTBEAT_FILENAME)
+    self._file = open(self._path, 'a', encoding='utf-8')
+
+  @property
+  def path(self) -> str:
+    return self._path
+
+  def log(self, kind: str, step: Optional[int] = None,
+          **payload) -> Dict[str, object]:
+    """Appends one record; returns it (tests and callers can reuse it)."""
+    record: Dict[str, object] = {'time': time.time(), 'kind': kind,
+                                 'step': None if step is None else int(step)}
+    record.update(payload)
+    self._file.write(json.dumps(record) + '\n')
+    return record
+
+  def heartbeat(self, step: Optional[int] = None, **extra) -> None:
+    """Atomically replaces the heartbeat file (never half-written)."""
+    beat: Dict[str, object] = {
+        'time': time.time(),
+        'step': None if step is None else int(step),
+        'pid': os.getpid(),
+        'hostname': socket.gethostname(),
+    }
+    beat.update(extra)
+    tmp = self._heartbeat_path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+      json.dump(beat, f)
+    os.replace(tmp, self._heartbeat_path)
+
+  def flush(self) -> None:
+    self._file.flush()
+
+  def close(self) -> None:
+    if not self._file.closed:
+      self._file.flush()
+      self._file.close()
+
+
+def read_telemetry(path: str) -> List[Dict[str, object]]:
+  """Parses a telemetry.jsonl file (or the model_dir holding one).
+
+  A torn FINAL line (writer killed mid-append) is dropped silently;
+  malformed interior lines raise ValueError naming the line number.
+  """
+  if os.path.isdir(path):
+    path = os.path.join(path, TELEMETRY_FILENAME)
+  records: List[Dict[str, object]] = []
+  with open(path, encoding='utf-8') as f:
+    lines = f.read().splitlines()
+  for index, line in enumerate(lines):
+    if not line.strip():
+      continue
+    try:
+      records.append(json.loads(line))
+    except ValueError as e:
+      if index == len(lines) - 1:
+        break  # torn tail from a killed writer: ignore
+      raise ValueError('{}:{} holds malformed telemetry: {}'.format(
+          path, index + 1, e)) from e
+  return records
+
+
+def read_heartbeat(model_dir: str) -> Optional[Dict[str, object]]:
+  """The last heartbeat written under ``model_dir``, or None."""
+  path = os.path.join(model_dir, HEARTBEAT_FILENAME)
+  if not os.path.exists(path):
+    return None
+  with open(path, encoding='utf-8') as f:
+    return json.load(f)

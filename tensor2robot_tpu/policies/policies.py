@@ -16,16 +16,71 @@ apply can instead run the whole CEM loop on-device via
 from __future__ import annotations
 
 import abc
+import functools
+import time
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
+from tensor2robot_tpu.observability import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    get_registry,
+)
 from tensor2robot_tpu.utils import cross_entropy
+
+# Robot-control-loop latency: one observation per SelectAction call,
+# labeled by the concrete policy class. At 1-10 Hz control (SURVEY §3.5)
+# the p95/p99 of this histogram IS the product metric — a CEM policy
+# whose three predictor round trips tail past the control period drops
+# robot actions, which no throughput number will show.
+POLICY_LATENCY_HISTOGRAM = 'policy/select_action_ms'
+
+# (registry, class name) -> resolved series; the 1-10 Hz control loop
+# must not pay a registry lock per action (same memo discipline — and
+# the same registry-object key — as predictors/abstract_predictor.py).
+_SERIES_CACHE: dict = {}
+
+
+def _latency_series(policy_name: str):
+  registry = get_registry()
+  key = (registry, policy_name)
+  series = _SERIES_CACHE.get(key)
+  if series is None:
+    series = registry.histogram_family(
+        POLICY_LATENCY_HISTOGRAM, ('policy',),
+        bounds=DEFAULT_LATENCY_BUCKETS_MS).series(policy_name)
+    _SERIES_CACHE[key] = series
+  return series
+
+
+def _instrument_select_action(fn):
+  """Times SelectAction into the policy latency histogram."""
+
+  @functools.wraps(fn)
+  def wrapper(self, state, context, timestep):
+    start = time.perf_counter()
+    action = fn(self, state, context, timestep)
+    _latency_series(type(self).__name__).record(
+        (time.perf_counter() - start) * 1e3)
+    return action
+
+  wrapper._t2r_instrumented = True  # noqa: SLF001 — idempotence marker
+  return wrapper
 
 
 class Policy(abc.ABC):
   """Base policy backed by an optional predictor (ref :39)."""
+
+  def __init_subclass__(cls, **kwargs):
+    # Every concrete policy's own SelectAction is wrapped at class
+    # creation (same pattern as AbstractPredictor): latency telemetry is
+    # structural, not something each policy remembers to add.
+    super().__init_subclass__(**kwargs)
+    fn = cls.__dict__.get('SelectAction')
+    if fn is not None and callable(fn) and not getattr(
+        fn, '_t2r_instrumented', False):
+      cls.SelectAction = _instrument_select_action(fn)
 
   def __init__(self, predictor=None):
     self._predictor = predictor

@@ -3,18 +3,124 @@
 Parity target: /root/reference/predictors/abstract_predictor.py:32-87. The
 contract robot-side code programs against: ``predict(features_dict)``,
 spec getters, ``restore``/``init_randomly``/``close``, and version metadata.
+
+Every concrete predictor is instrumented automatically (ISSUE 3): the
+base class wraps each subclass's own ``predict``/``restore`` at class
+creation, so robot-control-loop latency lands in the registry histogram
+``inference/latency_ms/<PredictorClass>`` (p50/p95/p99 via
+``Histogram.summary``) and model refreshes in
+``inference/restores/<PredictorClass>/<outcome>`` — with zero per-call
+work in subclasses and no way for a new predictor to forget the wiring.
 """
 
 from __future__ import annotations
 
 import abc
+import functools
+import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.observability import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    get_registry,
+)
+
+INFERENCE_LATENCY_HISTOGRAM = 'inference/latency_ms'
+INFERENCE_RESTORES_COUNTER = 'inference/restores'
+INFERENCE_ERRORS_COUNTER = 'inference/errors'
+
+# Reentrancy guard: predict_serialized usually routes through predict();
+# only the OUTERMOST instrumented call on a thread records, so one robot
+# request is one histogram observation, never two.
+_call_depth = threading.local()
+
+
+# (registry, class name) -> resolved series. The serving hot path must
+# not pay a registry lock + family lookup per call ("resolve labeled
+# series once outside loops", registry.py). Keyed by the registry OBJECT
+# (identity hash, strong ref — ids are never recycled under the cache),
+# so a swapped test registry never receives another registry's series.
+_SERIES_CACHE: Dict[tuple, object] = {}
+
+
+def _latency_histogram(predictor_name: str):
+  """The per-predictor-class latency series (label = concrete class)."""
+  registry = get_registry()
+  key = (registry, predictor_name)
+  series = _SERIES_CACHE.get(key)
+  if series is None:
+    series = registry.histogram_family(
+        INFERENCE_LATENCY_HISTOGRAM, ('predictor',),
+        bounds=DEFAULT_LATENCY_BUCKETS_MS).series(predictor_name)
+    _SERIES_CACHE[key] = series
+  return series
+
+
+def _instrument_predict(fn):
+  """Times successful predict-path calls; failures count separately (an
+  exploding latency histogram and an error burst are different pages)."""
+
+  @functools.wraps(fn)
+  def wrapper(self, features, *args, **kwargs):
+    name = type(self).__name__
+    depth = getattr(_call_depth, 'value', 0)
+    _call_depth.value = depth + 1
+    start = time.perf_counter()
+    try:
+      outputs = fn(self, features, *args, **kwargs)
+    except Exception:
+      if depth == 0:
+        get_registry().counter_family(
+            INFERENCE_ERRORS_COUNTER, ('predictor',)).series(name).inc()
+      raise
+    finally:
+      _call_depth.value = depth
+    if depth == 0:
+      _latency_histogram(name).record((time.perf_counter() - start) * 1e3)
+    return outputs
+
+  wrapper._t2r_instrumented = True  # noqa: SLF001 — idempotence marker
+  return wrapper
+
+
+def _instrument_restore(fn):
+  """Counts restore/refresh attempts by outcome (success vs timeout)."""
+
+  @functools.wraps(fn)
+  def wrapper(self, *args, **kwargs):
+    result = fn(self, *args, **kwargs)
+    get_registry().counter_family(
+        INFERENCE_RESTORES_COUNTER, ('predictor', 'outcome')).series(
+            type(self).__name__,
+            'timeout' if result is False else 'success').inc()
+    return result
+
+  wrapper._t2r_instrumented = True  # noqa: SLF001
+  return wrapper
+
 
 class AbstractPredictor(abc.ABC):
   """Loads a model and exposes a predict function (ref :32)."""
+
+  def __init_subclass__(cls, **kwargs):
+    # Wrap only methods DEFINED on this subclass: inherited methods were
+    # wrapped on their defining class (the label reads the runtime type,
+    # so an inheriting predictor still reports under its own name).
+    # predict_serialized is wrapped too — a SavedModel predictor serving
+    # tf.Example bytes never touches predict(); the thread-local depth
+    # guard keeps implementations that DO route through predict() from
+    # double-counting one request.
+    super().__init_subclass__(**kwargs)
+    for method, instrument in (('predict', _instrument_predict),
+                               ('predict_serialized', _instrument_predict),
+                               ('restore', _instrument_restore)):
+      fn = cls.__dict__.get(method)
+      if fn is not None and callable(fn) and not getattr(
+          fn, '_t2r_instrumented', False):
+        setattr(cls, method, instrument(fn))
 
   @abc.abstractmethod
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -18,6 +18,7 @@ import numpy as np
 
 from tensor2robot_tpu.export.export_generators import make_serve_fn
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import get_registry
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
 from tensor2robot_tpu.reliability.errors import CHECKPOINT_SKIP_ERRORS
 from tensor2robot_tpu.reliability.logutil import log_warning
@@ -25,6 +26,11 @@ from tensor2robot_tpu.specs import generators as spec_generators
 from tensor2robot_tpu.trainer import checkpointing
 
 _POLL_INTERVAL_SECS = 1.0
+# How often the (otherwise silent) checkpoint wait announces itself. A
+# robot host stuck here looks exactly like a healthy idle one without
+# the periodic log + gauge.
+_WAIT_REPORT_INTERVAL_SECS = 10.0
+CHECKPOINT_WAIT_GAUGE = 'inference/checkpoint_wait_seconds'
 
 
 class CheckpointPredictor(AbstractPredictor):
@@ -73,26 +79,49 @@ class CheckpointPredictor(AbstractPredictor):
       raise ValueError('CheckpointPredictor constructed without a '
                        'checkpoint_dir; call init_randomly() instead.')
     # monotonic: a wall-clock jump must not expire (or extend) the wait.
-    deadline = time.monotonic() + self._timeout
-    while True:
-      steps = checkpointing.all_checkpoint_steps(self._checkpoint_dir)
-      floor = self._restored_step if self._restored_step is not None else -1
-      # Newest first, but never DOWNGRADE below what is already loaded: a
-      # permanently damaged newest step must not block serving when older
-      # intact checkpoints sit in the same directory.
-      candidates = [s for s in steps if s > floor]
-      if not candidates and self._restored_step is not None and steps:
-        return True  # nothing newer; current state is still valid
-      for step in candidates:
-        try:
-          return self._load_step(step)
-        except CHECKPOINT_SKIP_ERRORS as e:
+    wait_start = time.monotonic()
+    deadline = wait_start + self._timeout
+    next_report = wait_start + _WAIT_REPORT_INTERVAL_SECS
+    # Labeled per watched directory: one predictor finishing its wait
+    # must not zero out another instance's in-progress wait signal.
+    wait_gauge = get_registry().gauge_family(
+        CHECKPOINT_WAIT_GAUGE, ('dir',)).series(self._checkpoint_dir)
+    try:
+      while True:
+        steps = checkpointing.all_checkpoint_steps(self._checkpoint_dir)
+        floor = self._restored_step if self._restored_step is not None else -1
+        # Newest first, but never DOWNGRADE below what is already loaded: a
+        # permanently damaged newest step must not block serving when older
+        # intact checkpoints sit in the same directory.
+        candidates = [s for s in steps if s > floor]
+        if not candidates and self._restored_step is not None and steps:
+          return True  # nothing newer; current state is still valid
+        for step in candidates:
+          try:
+            return self._load_step(step)
+          except CHECKPOINT_SKIP_ERRORS as e:
+            log_warning(
+                'CheckpointPredictor: step %d in %s failed to restore (%s); '
+                'trying an older checkpoint.', step, self._checkpoint_dir, e)
+        now = time.monotonic()
+        if now >= next_report:
+          # Waiting is expected (the trainer may simply not have committed
+          # yet) but must never be silent: a wedged trainer and a healthy
+          # cold start look identical without this heartbeat.
+          elapsed = now - wait_start
+          wait_gauge.set(elapsed)
           log_warning(
-              'CheckpointPredictor: step %d in %s failed to restore (%s); '
-              'trying an older checkpoint.', step, self._checkpoint_dir, e)
-      if time.monotonic() > deadline:
-        return False
-      time.sleep(_POLL_INTERVAL_SECS)
+              'CheckpointPredictor: still waiting for a checkpoint in %s '
+              '(%.0fs elapsed, %.0fs until timeout).', self._checkpoint_dir,
+              elapsed, max(deadline - now, 0.0))
+          next_report = now + _WAIT_REPORT_INTERVAL_SECS
+        if now > deadline:
+          return False
+        time.sleep(_POLL_INTERVAL_SECS)
+    finally:
+      # The wait ended (loaded, still-valid, or timed out): stop
+      # advertising a stale in-progress wait to dashboards.
+      wait_gauge.set(0.0)
 
   def _load_step(self, step: int) -> bool:
     # quarantine_damaged=False: this is a read-only consumer of another

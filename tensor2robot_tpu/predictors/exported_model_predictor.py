@@ -28,11 +28,15 @@ import jax
 import numpy as np
 
 from tensor2robot_tpu.export import export_generators
+from tensor2robot_tpu.observability import get_registry
 from tensor2robot_tpu.predictors.abstract_predictor import AbstractPredictor
+from tensor2robot_tpu.reliability.logutil import log_warning
 from tensor2robot_tpu.specs import assets as assets_lib
 from tensor2robot_tpu.specs.struct import SpecStruct  # predict_serialized
 
 _POLL_INTERVAL_SECS = 1.0
+_WAIT_REPORT_INTERVAL_SECS = 10.0
+EXPORT_WAIT_GAUGE = 'inference/export_wait_seconds'
 
 
 class ExportedModelPredictor(AbstractPredictor):
@@ -108,21 +112,41 @@ class ExportedModelPredictor(AbstractPredictor):
 
   def restore(self) -> bool:
     """Polls for a version newer than the current one (ref :120-148)."""
-    deadline = time.time() + self._timeout
-    while True:
-      versions = export_generators.list_exported_versions(self._export_dir)
-      fresh = [v for v in versions
-               if self._version is None or v > self._version]
-      # Newest first; a vanished/partial dir falls back to the next one
-      # (ref :160-198 retry semantics).
-      for version in reversed(fresh):
-        if self._try_load_version(version):
-          return True
-      if self._version is not None and versions:
-        return True  # current version still newest and valid
-      if time.time() > deadline:
-        return False
-      time.sleep(_POLL_INTERVAL_SECS)
+    # monotonic (matching CheckpointPredictor): a wall-clock jump must
+    # not expire or extend the polling budget.
+    wait_start = time.monotonic()
+    deadline = wait_start + self._timeout
+    next_report = wait_start + _WAIT_REPORT_INTERVAL_SECS
+    # Labeled per export root: concurrent predictors must not clobber
+    # each other's wait signal (see CheckpointPredictor.restore).
+    wait_gauge = get_registry().gauge_family(
+        EXPORT_WAIT_GAUGE, ('dir',)).series(self._export_dir)
+    try:
+      while True:
+        versions = export_generators.list_exported_versions(self._export_dir)
+        fresh = [v for v in versions
+                 if self._version is None or v > self._version]
+        # Newest first; a vanished/partial dir falls back to the next one
+        # (ref :160-198 retry semantics).
+        for version in reversed(fresh):
+          if self._try_load_version(version):
+            return True
+        if self._version is not None and versions:
+          return True  # current version still newest and valid
+        now = time.monotonic()
+        if now >= next_report:
+          elapsed = now - wait_start
+          wait_gauge.set(elapsed)
+          log_warning(
+              'ExportedModelPredictor: still waiting for an export in %s '
+              '(%.0fs elapsed, %.0fs until timeout).', self._export_dir,
+              elapsed, max(deadline - now, 0.0))
+          next_report = now + _WAIT_REPORT_INTERVAL_SECS
+        if now > deadline:
+          return False
+        time.sleep(_POLL_INTERVAL_SECS)
+    finally:
+      wait_gauge.set(0.0)
 
   # -- serving ---------------------------------------------------------------
 

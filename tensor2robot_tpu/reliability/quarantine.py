@@ -6,6 +6,12 @@ in train metrics (trainer/train_eval.py log path), and blowing either the
 per-file or the global budget raises ``CorruptionBudgetExceeded`` naming
 the offending file — dirty data degrades gracefully up to a configured
 point, then fails the run on purpose.
+
+Process-wide totals live in the telemetry registry
+(``data/corrupt_records_skipped``, ``data/corrupt_files_abandoned``) —
+the trainer's unified export pipeline picks them up without holding
+references to generator instances (which may live behind prefetch
+threads). ``aggregate_metrics`` remains as the stable read API.
 """
 
 from __future__ import annotations
@@ -13,31 +19,29 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from tensor2robot_tpu.observability import get_registry
 from tensor2robot_tpu.reliability.errors import CorruptionBudgetExceeded
 
-# Process-wide totals, aggregated across every RecordQuarantine so the
-# trainer can surface them without holding references to the generators'
-# instances (generators may live behind prefetch threads).
-_TOTALS_LOCK = threading.Lock()
-_TOTAL_RECORDS_SKIPPED = 0
-_TOTAL_FILES_ABANDONED = 0
+RECORDS_SKIPPED_COUNTER = 'data/corrupt_records_skipped'
+FILES_ABANDONED_COUNTER = 'data/corrupt_files_abandoned'
 
 
 def aggregate_metrics() -> Dict[str, float]:
   """Counters for the train-metrics writer (monotonic within a process)."""
-  with _TOTALS_LOCK:
-    return {
-        'data/corrupt_records_skipped': float(_TOTAL_RECORDS_SKIPPED),
-        'data/corrupt_files_abandoned': float(_TOTAL_FILES_ABANDONED),
-    }
+  registry = get_registry()
+  return {
+      RECORDS_SKIPPED_COUNTER:
+          registry.counter(RECORDS_SKIPPED_COUNTER).value,
+      FILES_ABANDONED_COUNTER:
+          registry.counter(FILES_ABANDONED_COUNTER).value,
+  }
 
 
 def reset_aggregate_metrics() -> None:
   """Test hook: zero the process-wide counters."""
-  global _TOTAL_RECORDS_SKIPPED, _TOTAL_FILES_ABANDONED
-  with _TOTALS_LOCK:
-    _TOTAL_RECORDS_SKIPPED = 0
-    _TOTAL_FILES_ABANDONED = 0
+  registry = get_registry()
+  registry.counter(RECORDS_SKIPPED_COUNTER).reset()
+  registry.counter(FILES_ABANDONED_COUNTER).reset()
 
 
 class RecordQuarantine:
@@ -80,7 +84,6 @@ class RecordQuarantine:
     corrupt record must count against the budget once, not once per
     epoch — otherwise a small fixed amount of damage kills a long run.
     """
-    global _TOTAL_RECORDS_SKIPPED
     with self._lock:
       if record_index is not None:
         key = (path, record_index)
@@ -92,8 +95,7 @@ class RecordQuarantine:
       self._skipped_by_file[path] = in_file
       over_file = in_file > self._max_per_file
       over_total = self._skipped_total > self._max_total
-    with _TOTALS_LOCK:
-      _TOTAL_RECORDS_SKIPPED += 1
+    get_registry().counter(RECORDS_SKIPPED_COUNTER).inc()
     if over_file:
       raise CorruptionBudgetExceeded(path, 'file', self._max_per_file)
     if over_total:
@@ -101,15 +103,13 @@ class RecordQuarantine:
 
   def file_abandoned(self, path: str, reason: str = '') -> None:
     """Marks the remainder of ``path`` unreadable (framing lost)."""
-    global _TOTAL_FILES_ABANDONED
     newly = False
     with self._lock:
       if path not in self._abandoned_files:
         self._abandoned_files[path] = reason
         newly = True
     if newly:
-      with _TOTALS_LOCK:
-        _TOTAL_FILES_ABANDONED += 1
+      get_registry().counter(FILES_ABANDONED_COUNTER).inc()
 
   def summary(self) -> Dict[str, object]:
     with self._lock:

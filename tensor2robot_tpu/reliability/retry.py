@@ -13,12 +13,18 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type, TypeVar
 
+from tensor2robot_tpu.observability import get_registry
 from tensor2robot_tpu.reliability.errors import (
     RetryError,
     TRANSIENT_IO_ERRORS,
 )
 
 T = TypeVar('T')
+
+# Every retried failure is charged here, labeled by site — fleet-visible
+# evidence of a flaky mount long before a RetryError kills a run. The
+# family resolves lazily so a swapped test registry is honored.
+_RETRY_COUNTER_NAME = 'reliability/io_retries'
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +92,8 @@ def retry(fn: Callable[[], T],
       if attempt + 1 >= attempts:
         break
       delay = policy.delay_secs(attempt, rng=rng)
+      get_registry().counter_family(
+          _RETRY_COUNTER_NAME, ('site',)).series(site or 'unknown').inc()
       if on_retry is not None:
         on_retry(site or '', attempt, e, delay)
       sleep(delay)

@@ -29,6 +29,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.observability import span
 from tensor2robot_tpu.reliability import fault_injection
 from tensor2robot_tpu.reliability.errors import CorruptCheckpointError
 from tensor2robot_tpu.reliability.logutil import log_warning as _log
@@ -123,8 +124,12 @@ class CheckpointManager:
           int(step), args=ocp.args.StandardSave(state), metrics=metrics,
           force=force)
 
-    return retry(_save, self._retry_policy,
-                 site=fault_injection.SITE_CKPT_SAVE)
+    # The span holds only the SYNCHRONOUS portion; with async
+    # checkpointing the background commit is invisible here (the trainer
+    # sees it at wait_until_finished).
+    with span('ckpt.save'):
+      return retry(_save, self._retry_policy,
+                   site=fault_injection.SITE_CKPT_SAVE)
 
   def restore(self, state_template, step: Optional[int] = None):
     """Restores into the structure/shardings of ``state_template``.
@@ -146,8 +151,9 @@ class CheckpointManager:
           int(step), args=ocp.args.StandardRestore(state_template))
 
     try:
-      return retry(_restore, self._retry_policy,
-                   site=fault_injection.SITE_CKPT_RESTORE)
+      with span('ckpt.restore'):
+        return retry(_restore, self._retry_policy,
+                     site=fault_injection.SITE_CKPT_RESTORE)
     except (ValueError, KeyError) as e:
       # Orbax reports a half-written or GC-gutted step dir as assorted
       # ValueErrors ('Must provide args of type Composite...') — these

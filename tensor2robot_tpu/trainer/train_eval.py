@@ -33,6 +33,14 @@ from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
 from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, TrainState
 from tensor2robot_tpu.models.model_interface import ModelInterface
 from tensor2robot_tpu.modes import ModeKeys
+from tensor2robot_tpu.observability import (
+    GoodputTracker,
+    TelemetryLogger,
+    get_registry,
+    set_trace_active,
+    span,
+)
+from tensor2robot_tpu.observability import goodput as goodput_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import sharding as sharding_lib
 from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
@@ -61,6 +69,14 @@ def _log(msg: str, *args) -> None:
     from absl import logging as _absl_logging  # deferred: absl optional
     _logv = _absl_logging.info
   _logv(msg, *args)
+
+
+def _json_scalar(value):
+  """Host scalar -> JSON-safe float (NaN/inf become None; arrays mean)."""
+  if value is None:
+    return None
+  value = float(np.mean(value))
+  return value if np.isfinite(value) else None
 
 
 def provide_input_generator_with_model_information(
@@ -157,6 +173,8 @@ class Trainer:
     self._nan_check_every_n_steps = max(1, int(nan_check_every_n_steps))
     self._train_writer = None
     self._eval_writer = None
+    self._telemetry = None
+    self._last_goodput = None
     self._device_feed = None
     self._device_feed_built = False
 
@@ -194,6 +212,18 @@ class Trainer:
       self._eval_writer = MetricsWriter(os.path.join(self.model_dir, subdir))
     return self._eval_writer
 
+  @property
+  def telemetry_logger(self):
+    """Lazy telemetry.jsonl + heartbeat writer (None when metrics are off)."""
+    if self._write_metrics and self._telemetry is None:
+      self._telemetry = TelemetryLogger(self.model_dir)
+    return self._telemetry
+
+  @property
+  def last_goodput(self):
+    """The GoodputTracker of the most recent train() call (or None)."""
+    return self._last_goodput
+
   def _maybe_profile(self, step_i: int) -> None:
     """Starts/stops the one configured jax.profiler trace window."""
     if self._profile_steps is None:
@@ -205,11 +235,15 @@ class Trainer:
         # logdir root so TensorBoard's profile plugin finds the trace.
         jax.profiler.start_trace(self.model_dir)
         self._profiling = True
+        # Spans now also emit TraceAnnotations, so the host-side seams
+        # (data.next, ckpt.save) show up as rows in this capture.
+        set_trace_active(True)
       except Exception as e:  # noqa: BLE001 — profiling is best-effort
         _log('Profiler unavailable: %s', e)
         self._profile_steps = None
     elif self._profiling and step_i >= stop:
       jax.profiler.stop_trace()
+      set_trace_active(False)
       self._profiling = False
       self._profile_steps = None
       _log('Profiler trace written to %s',
@@ -420,7 +454,10 @@ class Trainer:
     batch_size = int(jax.tree_util.tree_leaves(features.to_dict())[0].shape[0])
     for hook in hooks:
       hook.begin(self)
-    t_last = time.time()
+    # perf_counter, not time.time(): steps/sec and goodput must survive
+    # wall-clock jumps (NTP step, DST) — the monotonic-deadline discipline
+    # the reliability layer already follows (docs/reliability.md).
+    t_last = time.perf_counter()
     steps_since_log = 0
     metrics = None
     step_i = start_step
@@ -428,70 +465,149 @@ class Trainer:
     rollback_budget = self._nan_rollback_budget
     host_nan_check = self._nan_policy in ('raise', 'rollback')
     completed = False
+    # Goodput accounting: every loop second lands in exactly one of
+    # productive / data / checkpoint / retry (docs/observability.md).
+    tracker = GoodputTracker()
+    self._last_goodput = tracker
+    registry = get_registry()
+    # Pre-register the well-known reliability counters: a dashboard must
+    # see an explicit 0.0 on a clean run (an absent tag is
+    # indistinguishable from broken wiring — the guarantee the pre-registry
+    # quarantine export already gave).
+    registry.counter(quarantine_lib.RECORDS_SKIPPED_COUNTER)
+    registry.counter(quarantine_lib.FILES_ABANDONED_COUNTER)
+    registry.counter('reliability/nan_rollbacks')
+    registry.counter('reliability/preemptions')
+    telemetry = self.telemetry_logger
+    if telemetry is not None:
+      telemetry.log('run_start', step=start_step,
+                    max_train_steps=int(max_train_steps),
+                    batch_size=batch_size, nan_policy=self._nan_policy)
+      telemetry.flush()
+
+    def commit_goodput(iter_start, data_s, ckpt_s, retry_s):
+      # ``productive`` is the remainder, so the categories partition the
+      # iteration's wall time exactly and fractions sum to 1.0.
+      total = time.perf_counter() - iter_start
+      tracker.add(goodput_lib.DATA, data_s)
+      tracker.add(goodput_lib.CHECKPOINT, ckpt_s)
+      tracker.add(goodput_lib.RETRY, retry_s)
+      tracker.add(goodput_lib.PRODUCTIVE,
+                  total - data_s - ckpt_s - retry_s)
+
     with graceful_shutdown() as shutdown:
       try:
         while step_i < max_train_steps:
-          self._maybe_profile(step_i)
-          features, labels = batch
-          device_batch = self._put_batch(
-              {'features': features.to_dict(),
-               'labels': labels.to_dict() if labels is not None else None})
-          force_nan = np.asarray(
-              fault_injection.fires(fault_injection.SITE_STEP_NAN))
-          state, metrics = step_fn(state, device_batch['features'],
-                                   device_batch['labels'], base_rng,
-                                   force_nan)
-          step_i += 1
-          steps_since_log += 1
-          # The sentinel also fires on every step that is about to be
-          # checkpointed (periodic or final): with nan_check_every_n_steps
-          # > 1 an unvetted save could otherwise commit NaN params, and a
-          # later rollback would restore the poison.
-          if host_nan_check and (
-              step_i % self._nan_check_every_n_steps == 0
-              or step_i % self.save_checkpoints_steps == 0
-              or step_i == max_train_steps):
-            state, step_i, rolled_back = self._check_finite_loss(
-                state, metrics, step_i, rollback_budget)
-            if rolled_back:
-              rollback_budget -= 1
+          iter_start = time.perf_counter()
+          data_s = ckpt_s = retry_s = 0.0
+          # try/finally, not explicit commit calls: an iteration that
+          # exits via continue, preemption, OR an exception (NaN raise,
+          # corruption budget, retry exhaustion — often the longest,
+          # most interesting seconds) still lands in the accounting.
+          try:
+            self._maybe_profile(step_i)
+            features, labels = batch
+            with span('data.put_batch') as sp:
+              device_batch = self._put_batch(
+                  {'features': features.to_dict(),
+                   'labels': labels.to_dict() if labels is not None
+                   else None})
+            data_s += sp.elapsed
+            force_nan = np.asarray(
+                fault_injection.fires(fault_injection.SITE_STEP_NAN))
+            # NOTE: the step span measures dispatch, not device compute —
+            # jax returns before the XLA program finishes. Device time
+            # comes from the profiler trace (utils/xplane.py); host-side
+            # blocking (donated-buffer backpressure) does land here.
+            with span('train.step'):
+              state, metrics = step_fn(state, device_batch['features'],
+                                       device_batch['labels'], base_rng,
+                                       force_nan)
+            step_i += 1
+            steps_since_log += 1
+            # The sentinel also fires on every step that is about to be
+            # checkpointed (periodic or final): with nan_check_every_n_steps
+            # > 1 an unvetted save could otherwise commit NaN params, and a
+            # later rollback would restore the poison.
+            if host_nan_check and (
+                step_i % self._nan_check_every_n_steps == 0
+                or step_i % self.save_checkpoints_steps == 0
+                or step_i == max_train_steps):
+              with span('train.nan_check') as sp:
+                state, step_i, rolled_back = self._check_finite_loss(
+                    state, metrics, step_i, rollback_budget)
+              if rolled_back:
+                # The whole check-and-restore, plus the re-fetch below, is
+                # recovery overhead, not productive time.
+                retry_s += sp.elapsed
+                rollback_budget -= 1
+                steps_since_log = 0
+                t_last = time.perf_counter()
+                with span('data.next') as sp:
+                  batch = next(iterator)
+                retry_s += sp.elapsed
+                continue
+            if (step_i % self.log_every_n_steps == 0
+                or step_i == max_train_steps):
+              metrics = jax.device_get(dict(metrics))
+              dt = time.perf_counter() - t_last
+              examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
+              self._throughput = (examples_per_sec,
+                                  dt / max(steps_since_log, 1))
+              _log('step %d: loss=%s (%.1f examples/sec)', step_i,
+                   metrics.get('loss'), examples_per_sec)
+              writer = self.train_metrics_writer
+              if writer is not None:
+                scalars = {k: float(np.mean(v)) for k, v in metrics.items()
+                           if np.ndim(v) == 0}
+                scalars['global_step/sec'] = 1.0 / max(
+                    dt / max(steps_since_log, 1), 1e-9)
+                scalars['examples/sec'] = examples_per_sec
+                # The unified telemetry pipeline: every registry counter/
+                # gauge/histogram-summary (quarantine, retries, rollbacks,
+                # span and inference latencies) plus the goodput split —
+                # tolerated damage and lost wall-clock are never invisible.
+                scalars.update(registry.scalars())
+                scalars.update(tracker.scalars())
+                writer.write_scalars(step_i, scalars)
+                writer.flush()
+              if telemetry is not None:
+                telemetry.log('train', step=step_i,
+                              loss=_json_scalar(metrics.get('loss')),
+                              examples_per_sec=examples_per_sec,
+                              goodput=tracker.fractions(),
+                              goodput_seconds=tracker.seconds(),
+                              counters=registry.snapshot()['counters'])
+                telemetry.heartbeat(step_i)
+                telemetry.flush()
+              t_last = time.perf_counter()
               steps_since_log = 0
-              t_last = time.time()
-              batch = next(iterator)
-              continue
-          if step_i % self.log_every_n_steps == 0 or step_i == max_train_steps:
-            metrics = jax.device_get(dict(metrics))
-            dt = time.time() - t_last
-            examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
-            self._throughput = (examples_per_sec, dt / max(steps_since_log, 1))
-            _log('step %d: loss=%s (%.1f examples/sec)', step_i,
-                 metrics.get('loss'), examples_per_sec)
-            writer = self.train_metrics_writer
-            if writer is not None:
-              scalars = {k: float(np.mean(v)) for k, v in metrics.items()
-                         if np.ndim(v) == 0}
-              scalars['global_step/sec'] = 1.0 / max(
-                  dt / max(steps_since_log, 1), 1e-9)
-              scalars['examples/sec'] = examples_per_sec
-              # Corrupt-record quarantine counters (reliability/quarantine):
-              # dirty data is tolerated within budget but never invisible.
-              scalars.update(quarantine_lib.aggregate_metrics())
-              writer.write_scalars(step_i, scalars)
-              writer.flush()
-            t_last = time.time()
-            steps_since_log = 0
-          if step_i % self.save_checkpoints_steps == 0:
-            self.save_checkpoint(state)
-          for hook in hooks:
-            hook.after_step(self, state, step_i, metrics)
-          if shutdown.requested:
-            # Commit everything before re-raising: the restart resumes
-            # from this exact step instead of the last periodic save.
-            self.save_checkpoint(state, force=True)
-            self.checkpoint_manager.wait_until_finished()
-            raise TrainingPreempted(shutdown.signum, step_i)
-          if step_i < max_train_steps:
-            batch = next(iterator)
+            if step_i % self.save_checkpoints_steps == 0:
+              ckpt_t0 = time.perf_counter()
+              self.save_checkpoint(state)
+              ckpt_s += time.perf_counter() - ckpt_t0
+            for hook in hooks:
+              hook.after_step(self, state, step_i, metrics)
+            if shutdown.requested:
+              # Commit everything before re-raising: the restart resumes
+              # from this exact step instead of the last periodic save.
+              ckpt_t0 = time.perf_counter()
+              self.save_checkpoint(state, force=True)
+              self.checkpoint_manager.wait_until_finished()
+              ckpt_s += time.perf_counter() - ckpt_t0
+              registry.counter('reliability/preemptions').inc()
+              if telemetry is not None:
+                telemetry.log('preempted', step=step_i,
+                              signum=int(shutdown.signum))
+                telemetry.heartbeat(step_i)
+                telemetry.flush()
+              raise TrainingPreempted(shutdown.signum, step_i)
+            if step_i < max_train_steps:
+              with span('data.next') as sp:
+                batch = next(iterator)
+              data_s += sp.elapsed
+          finally:
+            commit_goodput(iter_start, data_s, ckpt_s, retry_s)
         completed = True
       finally:
         # A dangling profiler trace breaks the next start_trace: stop it
@@ -501,6 +617,7 @@ class Trainer:
             jax.profiler.stop_trace()
           except Exception as e:  # noqa: BLE001 — already unwinding
             _log('Profiler stop on failure path failed: %s', e)
+          set_trace_active(False)
           self._profiling = False
           self._profile_steps = None
         if not completed:
@@ -508,9 +625,28 @@ class Trainer:
           # update ('raise', or 'rollback' with the budget exhausted) —
           # committing it would make the poison the newest checkpoint
           # and wedge every restart. Flush writers only in that case.
-          poisoned = isinstance(sys.exc_info()[1], NonFiniteLossError)
+          exc = sys.exc_info()[1]
+          poisoned = isinstance(exc, NonFiniteLossError)
+          if telemetry is not None and not isinstance(exc,
+                                                      TrainingPreempted):
+            # Preemption already wrote its own record above; everything
+            # else gets a final abort marker (best-effort — the original
+            # exception is unwinding and must stay the one raised).
+            try:
+              telemetry.log('run_abort', step=step_i,
+                            error=type(exc).__name__,
+                            goodput=tracker.fractions())
+            except Exception as e:  # noqa: BLE001
+              _log('Telemetry abort record failed: %s', e)
           self._flush_and_emergency_save(state, skip_save=poisoned)
+    final_t0 = time.perf_counter()
     self.save_checkpoint(state, force=True)
+    tracker.add(goodput_lib.CHECKPOINT, time.perf_counter() - final_t0)
+    if telemetry is not None:
+      telemetry.log('run_end', step=step_i, goodput=tracker.fractions(),
+                    goodput_seconds=tracker.seconds())
+      telemetry.heartbeat(step_i)
+      telemetry.flush()
     for hook in hooks:
       hook.end(self, state)
     return state
@@ -554,6 +690,13 @@ class Trainer:
       # type to know ``state`` is poisoned and must not be committed.
       raise NonFiniteLossError(
           step_i, 'rollback failed: {}'.format(e)) from e
+    # Rollbacks were log-only before the telemetry layer; now they are a
+    # first-class counter plus a jsonl event naming both steps.
+    get_registry().counter('reliability/nan_rollbacks').inc()
+    if self.telemetry_logger is not None:
+      self.telemetry_logger.log('rollback', step=step_i,
+                                restored_step=int(latest))
+      self.telemetry_logger.flush()
     return restored, int(latest), True
 
   def _flush_and_emergency_save(self, state, skip_save: bool = False) -> None:
@@ -571,7 +714,7 @@ class Trainer:
         self.checkpoint_manager.wait_until_finished()
       except Exception as e:  # noqa: BLE001
         _log('Emergency checkpoint failed: %s', e)
-    for writer in (self._train_writer, self._eval_writer):
+    for writer in (self._train_writer, self._eval_writer, self._telemetry):
       if writer is not None:
         try:
           writer.flush()
@@ -736,10 +879,10 @@ class Trainer:
   def close(self) -> None:
     self.checkpoint_manager.wait_until_finished()
     self.checkpoint_manager.close()
-    for writer in (self._train_writer, self._eval_writer):
+    for writer in (self._train_writer, self._eval_writer, self._telemetry):
       if writer is not None:
         writer.close()
-    self._train_writer = self._eval_writer = None
+    self._train_writer = self._eval_writer = self._telemetry = None
 
 
 def _maybe_snapshot_config(model_dir: str,
