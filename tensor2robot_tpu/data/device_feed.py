@@ -17,14 +17,25 @@ host->device transfer:
 
 Non-sparse batches pass through as a plain ``shard_batch``, so the Trainer
 routes every batch through :meth:`SparseCoefFeed.put_batch` unconditionally.
+
+The shape-stability contract above is ASSERTED as telemetry, not just
+documented: every emitted batch's shape signature lands in the
+``data/feed_shape_signatures`` gauge (must stay 1 — the observability
+watchdog's ``recompile`` trigger fires otherwise) and the per-bucket
+unpack-jit cache size in ``recompiles/coef_unpack`` (expected to grow
+once per bucket, then plateau).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from tensor2robot_tpu.data import jpeg_device
+from tensor2robot_tpu.observability import get_registry
 from tensor2robot_tpu.parallel import sharding as sharding_lib
+
+FEED_SHAPES_GAUGE = 'data/feed_shape_signatures'
+UNPACK_COMPILES_GAUGE = 'recompiles/coef_unpack'
 
 
 class SparseCoefFeed:
@@ -34,6 +45,10 @@ class SparseCoefFeed:
     self._shapes = dict(image_shapes)
     self._mesh = mesh
     self._jit_cache = {}
+    self._signatures: Dict[str, Set[Tuple]] = {}
+    registry = get_registry()
+    self._shape_gauge = registry.gauge(FEED_SHAPES_GAUGE)
+    self._unpack_gauge = registry.gauge(UNPACK_COMPILES_GAUGE)
 
   @classmethod
   def from_preprocessor(cls, preprocessor, mesh
@@ -83,12 +98,32 @@ class SparseCoefFeed:
       self._jit_cache[cache_key] = fn
     return fn
 
-  def put_batch(self, batch: dict) -> dict:
+  def _record_signature(self, features: dict, channel: str) -> None:
+    """Counts distinct emitted batch-shape signatures into the gauges.
+
+    The signature covers NAME and SHAPE of every feature the jitted step
+    will see — exactly the recompile key. Signatures are tracked per
+    ``channel`` because one feed serves several independently-jitted
+    programs (train step, eval step, summary pass), each shape-stable on
+    its own: an eval batch sized differently from train is legitimate
+    and must not trip the train invariant. The exported gauge covers
+    only the ``'train'`` channel — the contract the watchdog asserts.
+    """
+    signature = tuple(sorted(
+        (key, tuple(getattr(value, 'shape', ()))
+         ) for key, value in features.items()))
+    self._signatures.setdefault(channel, set()).add(signature)
+    self._shape_gauge.set(float(len(self._signatures.get('train', ()))))
+    self._unpack_gauge.set(float(len(self._jit_cache)))
+
+  def put_batch(self, batch: dict, channel: str = 'train') -> dict:
     """shard_batch + on-device sparse->dense coef unpack where present."""
     device = sharding_lib.shard_batch(batch, self._mesh)
     features = device.get('features')
     if not features or not any(
         key + '/sd' in features for key in self._shapes):
+      if features:
+        self._record_signature(features, channel)
       return device
     features = dict(features)
     for key, (height, width) in self._shapes.items():
@@ -101,6 +136,7 @@ class SparseCoefFeed:
       features[key + '/y'] = y
       features[key + '/cb'] = cb
       features[key + '/cr'] = cr
+    self._record_signature(features, channel)
     device = dict(device)
     device['features'] = features
     return device

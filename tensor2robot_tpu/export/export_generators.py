@@ -38,6 +38,13 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+try:
+  # jax >= 0.4.30 ships the stable module; plain `jax.export` attribute
+  # access is deprecation-gated on 0.4.x and raises AttributeError.
+  from jax import export as jax_export
+except ImportError:  # pragma: no cover - older jax without jax.export
+  jax_export = None
+
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.specs import assets as assets_lib
 from tensor2robot_tpu.specs import generators as spec_generators
@@ -232,9 +239,11 @@ class AbstractExportGenerator:
                                       np.asarray(v).dtype)
               for k, v in features.items()}
 
+    if jax_export is None:
+      return None
     try:
-      (batch_dim,) = jax.export.symbolic_shape('b')
-      exported = jax.export.export(jax.jit(serve))(
+      (batch_dim,) = jax_export.symbolic_shape('b')
+      exported = jax_export.export(jax.jit(serve))(
           variables_abstract, _features_abstract(batch_dim))
       return exported.serialize()
     except Exception:  # pylint: disable=broad-except
@@ -242,7 +251,7 @@ class AbstractExportGenerator:
     try:
       # Models that can't trace with a symbolic batch (e.g. fixed CEM
       # tiling) fall back to the warmup batch's concrete shape.
-      exported = jax.export.export(jax.jit(serve))(
+      exported = jax_export.export(jax.jit(serve))(
           variables_abstract,
           _features_abstract(int(np.shape(next(iter(features.values())))[0])))
       return exported.serialize()

@@ -18,12 +18,49 @@ The measurement substrate every perf/reliability PR builds on (ISSUE 3):
     ``telemetry.jsonl`` + atomically-replaced ``heartbeat.json`` under
     ``model_dir``; ``bin/t2r_telemetry`` tails and summarizes them.
 
-Metric name catalog and goodput definitions: docs/observability.md.
+Performance forensics (ISSUE 4) closes the loop from those numbers to
+answers:
+
+  * ``Watchdog`` (`watchdog.py`) — rolling-baseline anomaly detection
+    (step-time regression, goodput drop, recompiles, HBM growth,
+    heartbeat staleness) over the registry at the trainer's log cadence.
+  * ``AutoProfiler`` (`autoprofiler.py`) — budgeted, rate-limited
+    profiler capture windows triggered by the watchdog (static
+    ``profile_steps`` windows stay supported); every window ends as a
+    structured ``forensics/<step>.json`` report.
+  * `signals.py` — ``jax.monitoring`` compile-event listeners and
+    device-HBM/host-RSS watermark sampling into the registry.
+  * `forensics.py` — the report builder (top-k ops via `utils/xplane`,
+    collective stats via `parallel/hlo_analysis`, goodput attribution);
+    degrades to warnings on torn captures, never raises in the trainer.
+  * `doctor.py` — ranked offline diagnosis from telemetry.jsonl +
+    forensics reports (``bin/t2r_telemetry doctor``; jax-free).
+
+Metric name catalog, forensics report schema, and goodput definitions:
+docs/observability.md.
 """
 
+from tensor2robot_tpu.observability.autoprofiler import AutoProfiler
+from tensor2robot_tpu.observability.forensics import (
+    FORENSICS_DIRNAME,
+    attribute_goodput,
+    build_report,
+    read_reports,
+    write_report,
+)
 from tensor2robot_tpu.observability.goodput import (
     CATEGORIES as GOODPUT_CATEGORIES,
     GoodputTracker,
+)
+from tensor2robot_tpu.observability.signals import (
+    install_jax_listeners,
+    sample_memory,
+    uninstall_jax_listeners,
+)
+from tensor2robot_tpu.observability.watchdog import (
+    Anomaly,
+    Watchdog,
+    WatchdogConfig,
 )
 from tensor2robot_tpu.observability.registry import (
     Counter,
@@ -51,9 +88,12 @@ from tensor2robot_tpu.observability.telemetry_file import (
 )
 
 __all__ = [
+    'Anomaly',
+    'AutoProfiler',
     'Counter',
     'DEFAULT_LATENCY_BUCKETS_MS',
     'DEFAULT_SECONDS_BUCKETS',
+    'FORENSICS_DIRNAME',
     'Gauge',
     'GOODPUT_CATEGORIES',
     'GoodputTracker',
@@ -62,13 +102,22 @@ __all__ = [
     'TELEMETRY_FILENAME',
     'TelemetryLogger',
     'TelemetryRegistry',
+    'Watchdog',
+    'WatchdogConfig',
+    'attribute_goodput',
+    'build_report',
     'exponential_buckets',
     'get_registry',
+    'install_jax_listeners',
     'read_heartbeat',
+    'read_reports',
     'read_telemetry',
+    'sample_memory',
     'set_registry',
     'set_trace_active',
     'snapshot_delta',
     'span',
     'trace_active',
+    'uninstall_jax_listeners',
+    'write_report',
 ]

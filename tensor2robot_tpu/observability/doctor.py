@@ -1,0 +1,231 @@
+"""Ranked run diagnosis from telemetry.jsonl + forensics reports.
+
+The reading half of the forensics loop, for the operator who just got
+paged: ``t2r_telemetry doctor <model_dir>`` answers "what is wrong with
+this run" from the files alone — no jax import, no live process, works
+on any box that sees the filesystem (the same contract as the rest of
+``bin/t2r_telemetry``).
+
+Evidence consumed, in rough severity order:
+
+  * heartbeat.json age (watchdog staleness thresholds);
+  * the run's last lifecycle record (``run_abort`` / ``preempted``);
+  * the latest goodput split, with the data-loss case attributed across
+    HISTORY — "prefetch queue empty in 81% of samples" needs the gauge
+    series the trainer embeds in every ``train`` record, not one sample;
+  * recompile + shape-signature gauges (the device_feed invariant);
+  * device/host memory gauge trends across train records;
+  * ``anomaly`` records the in-process watchdog wrote;
+  * the newest forensics report's top op + occupancy.
+
+``diagnose`` returns ``Finding`` dicts ranked most-severe-first; the CLI
+prints them and exits non-zero only on CRITICAL findings so the command
+can gate automation without lying about missing telemetry (missing
+files are a diagnosis, not an error).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from tensor2robot_tpu.observability import forensics as forensics_lib
+from tensor2robot_tpu.observability import telemetry_file
+from tensor2robot_tpu.observability import watchdog as watchdog_lib
+
+__all__ = ['CRITICAL', 'WARNING', 'INFO', 'OK', 'diagnose',
+           'format_findings']
+
+CRITICAL = 'critical'
+WARNING = 'warning'
+INFO = 'info'
+OK = 'ok'
+
+_SEVERITY_RANK = {CRITICAL: 0, WARNING: 1, INFO: 2, OK: 3}
+
+# Goodput losses below this fraction are not worth a finding.
+_GOODPUT_FLOOR = 0.10
+
+
+def _finding(severity: str, message: str, **detail) -> Dict[str, object]:
+  return {'severity': severity, 'message': message, 'detail': detail}
+
+
+def _queue_empty_fraction(trains: List[Dict[str, object]]
+                          ) -> Optional[float]:
+  """Share of train samples whose prefetch queues were ALL empty."""
+  sampled = 0
+  empty = 0
+  for record in trains:
+    gauges = record.get('gauges') or {}
+    depths = [value for tag, value in gauges.items()
+              if tag.startswith('data/prefetch_queue_depth')]
+    if not depths:
+      continue
+    sampled += 1
+    if all(value <= 0.0 for value in depths):
+      empty += 1
+  return (empty / sampled) if sampled else None
+
+
+def _memory_trend(trains: List[Dict[str, object]], prefix: str
+                  ) -> Dict[str, List[float]]:
+  series: Dict[str, List[float]] = {}
+  for record in trains:
+    gauges = record.get('gauges') or {}
+    for tag, value in gauges.items():
+      if tag.startswith(prefix):
+        series.setdefault(tag, []).append(float(value))
+  return series
+
+
+def diagnose(model_dir: str,
+             now: Optional[float] = None,
+             heartbeat_stale_secs: float = 300.0
+             ) -> List[Dict[str, object]]:
+  """All findings for one model_dir, ranked most-severe first."""
+  if now is None:
+    now = time.time()  # wall-clock: compared to heartbeat timestamps
+  findings: List[Dict[str, object]] = []
+
+  telemetry_path = os.path.join(model_dir,
+                                telemetry_file.TELEMETRY_FILENAME)
+  records: List[Dict[str, object]] = []
+  if not os.path.exists(telemetry_path) or \
+      os.path.getsize(telemetry_path) == 0:
+    findings.append(_finding(
+        INFO, 'no telemetry.jsonl under {} — run never started its '
+        'telemetry, or metrics are disabled'.format(model_dir)))
+  else:
+    try:
+      records = telemetry_file.read_telemetry(telemetry_path)
+    except ValueError as e:
+      findings.append(_finding(
+          WARNING, 'telemetry.jsonl is corrupt mid-file: {}'.format(e)))
+
+  beat = telemetry_file.read_heartbeat(model_dir)
+  run_ended = bool(records) and records[-1].get('kind') in (
+      'run_end', 'run_abort', 'preempted')
+  if run_ended and beat is not None:
+    findings.append(_finding(
+        INFO, 'run finished ({}); heartbeat age not meaningful'.format(
+            records[-1].get('kind'))))
+  else:
+    for anomaly in watchdog_lib.check_heartbeat(
+        beat, now, stale_secs=heartbeat_stale_secs):
+      findings.append(_finding(
+          CRITICAL if beat is not None else INFO, anomaly.message,
+          **anomaly.detail))
+
+  trains = [r for r in records if r.get('kind') == 'train']
+  last = records[-1] if records else None
+  if last is not None and last.get('kind') == 'run_abort':
+    findings.append(_finding(
+        CRITICAL, 'run aborted at step {} with {}'.format(
+            last.get('step'), last.get('error'))))
+  elif last is not None and last.get('kind') == 'preempted':
+    findings.append(_finding(
+        WARNING, 'run was preempted at step {} (signal {}) and has not '
+        'resumed'.format(last.get('step'), last.get('signum'))))
+
+  # Goodput: rank the lost categories of the newest split, attributing
+  # the data case across the whole history.
+  goodput_records = [r for r in records
+                     if r.get('kind') in ('train', 'run_end')
+                     and r.get('goodput')]
+  if goodput_records:
+    latest = goodput_records[-1]
+    for category, fraction in sorted(latest['goodput'].items(),
+                                     key=lambda kv: -kv[1]):
+      if category == 'productive' or fraction < _GOODPUT_FLOOR:
+        continue
+      message = 'goodput lost to {} {:.0%}'.format(category, fraction)
+      if category == 'data':
+        empty = _queue_empty_fraction(trains)
+        if empty is not None:
+          message += ' -> prefetch queue empty in {:.0%} of samples'.format(
+              empty)
+          if empty > 0.5:
+            message += ' (host decode is the bottleneck; scale the input '
+            message += 'pipeline, not the model)'
+      findings.append(_finding(WARNING, message, category=category,
+                               fraction=fraction))
+
+  # Recompiles + the device_feed shape-stability invariant.
+  latest_gauges: Dict[str, float] = {}
+  for record in trains:
+    latest_gauges.update(record.get('gauges') or {})
+  recompiles = latest_gauges.get(watchdog_lib.RECOMPILE_GAUGE, 0.0)
+  if recompiles > 1.0:
+    findings.append(_finding(
+        WARNING, 'train step compiled {:g} times — a shape-unstable batch '
+        'reached the jitted step (expected exactly 1; see '
+        'data/device_feed.py)'.format(recompiles), recompiles=recompiles))
+  shapes = latest_gauges.get(watchdog_lib.FEED_SHAPES_GAUGE, 0.0)
+  if shapes > 1.0:
+    findings.append(_finding(
+        WARNING, 'device feed emitted {:g} distinct batch shape '
+        'signatures (must be 1)'.format(shapes)))
+
+  # Memory trends across the sampled history.
+  for tag, values in _memory_trend(
+      trains, watchdog_lib.DEVICE_BYTES_GAUGE).items():
+    if len(values) >= 4 and all(b > a for a, b in zip(values, values[1:])):
+      findings.append(_finding(
+          WARNING, '{} grew monotonically across {} samples '
+          '({:.1f} -> {:.1f} MiB): leak signature'.format(
+              tag, len(values), values[0] / 2**20, values[-1] / 2**20)))
+
+  # Watchdog anomaly records written in-process.
+  anomalies = [r for r in records if r.get('kind') == 'anomaly']
+  if anomalies:
+    by_kind: Dict[str, int] = {}
+    for record in anomalies:
+      by_kind[str(record.get('anomaly'))] = \
+          by_kind.get(str(record.get('anomaly')), 0) + 1
+    findings.append(_finding(
+        WARNING, 'watchdog fired {} anomaly record(s): {}'.format(
+            len(anomalies),
+            ', '.join('{} x{}'.format(kind, count)
+                      for kind, count in sorted(by_kind.items()))),
+        counts=by_kind))
+
+  # Newest forensics report: the attribution evidence.
+  reports = forensics_lib.read_reports(model_dir)
+  if reports:
+    step, report = reports[-1]
+    top_ops = report.get('top_ops') or []
+    if top_ops:
+      top = top_ops[0]
+      findings.append(_finding(
+          INFO, 'forensics@{} ({}): top op {} {:.2f} ms/step '
+          '({:.0%} of attributed time)'.format(
+              step, report.get('reason'), top.get('name'),
+              top.get('ms_per_step', 0.0), top.get('fraction', 0.0)),
+          report='{}/{}.json'.format(forensics_lib.FORENSICS_DIRNAME,
+                                     step)))
+    occupancy = report.get('device_occupancy') or {}
+    if occupancy.get('extent_ms'):
+      findings.append(_finding(
+          INFO, 'forensics@{}: device line {:.0%} occupied over a '
+          '{:.0f} ms window'.format(step, occupancy.get('occupancy', 0.0),
+                                    occupancy.get('extent_ms', 0.0))))
+    for warning in report.get('warnings') or []:
+      findings.append(_finding(INFO, 'forensics@{}: {}'.format(
+          step, warning)))
+
+  if not any(f['severity'] in (CRITICAL, WARNING) for f in findings):
+    findings.append(_finding(
+        OK, 'no anomalies in the available telemetry' if not records else
+        'no anomalies: heartbeat fresh, goodput healthy, no recompiles, '
+        'no watchdog events'))
+  findings.sort(key=lambda f: _SEVERITY_RANK.get(str(f['severity']), 9))
+  return findings
+
+
+def format_findings(findings: List[Dict[str, object]]) -> str:
+  tags = {CRITICAL: 'CRIT', WARNING: 'WARN', INFO: 'INFO', OK: ' OK '}
+  return '\n'.join('{} {}'.format(
+      tags.get(str(f['severity']), '????'), f['message'])
+      for f in findings)

@@ -1,0 +1,313 @@
+"""Structured trace reports: raw xplane capture -> forensics/<step>.json.
+
+A profiler window that ends as an unread ``.xplane.pb`` proto answered
+nothing. This module turns each capture into the report a human (or
+``t2r_telemetry doctor``) actually wants, using only in-tree readers:
+
+  * top-k op families by device time (`utils/xplane.py` — the round-5
+    attribution machinery, now automated), with a host-executor fallback
+    for captures without a TPU plane (CPU runs name their XLA thunks on
+    ``tf_...`` executor thread lines);
+  * device occupancy + host-vs-device overlap from event offsets (the
+    idle-gap complement of goodput's host-side view);
+  * collective counts/bytes from the compiled step's HLO
+    (`parallel/hlo_analysis.py`), when the trainer can provide it;
+  * the goodput split of the surrounding run with a ranked attribution
+    ("lost to data 34% -> prefetch queue empty at sample time");
+  * the registry counter delta across the capture window.
+
+``build_report`` NEVER raises: every section degrades to a ``warnings``
+entry on torn/truncated/ambiguous captures (tests/test_xplane.py drives
+those paths), because it runs inside the trainer loop where an exception
+would cost the training run a profiler bug was supposed to explain.
+
+Report schema (``schema`` field, versioned): docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tensor2robot_tpu.observability import registry as registry_lib
+
+__all__ = ['FORENSICS_DIRNAME', 'REPORT_SCHEMA', 'build_report',
+           'write_report', 'read_reports', 'find_latest_xplane',
+           'attribute_goodput']
+
+FORENSICS_DIRNAME = 'forensics'
+REPORT_SCHEMA = 't2r.forensics.v1'
+DEFAULT_TOP_K = 15
+
+# Fractions below this are noise, not a diagnosis.
+_ATTRIBUTION_FLOOR = 0.05
+
+
+def find_latest_xplane(model_dir: str,
+                       newer_than: Optional[float] = None) -> Optional[str]:
+  """Newest ``*.xplane.pb`` under model_dir's profile plugin dir, or None.
+
+  ``newer_than`` (st_mtime) filters out captures from EARLIER windows of
+  the same run — stop_trace always writes a fresh file.
+  """
+  pattern = os.path.join(model_dir, 'plugins', 'profile', '**',
+                         '*.xplane.pb')
+  best: Tuple[float, Optional[str]] = (-1.0, None)
+  for path in glob.glob(pattern, recursive=True):
+    try:
+      mtime = os.stat(path).st_mtime
+    except OSError:
+      continue
+    if newer_than is not None and mtime < newer_than:
+      continue
+    if mtime > best[0]:
+      best = (mtime, path)
+  return best[1]
+
+
+def _device_top_ops(xplane_path: str, n_steps: int, top_k: int):
+  """(top_ops, occupancy, overlap, warnings) from one capture.
+
+  Prefers the TPU ``XLA Ops`` line (serial device stream). A capture
+  with several TPU planes (multi-chip) is narrowed to the first plane —
+  summing across chips would multiply ms/step by the chip count — with a
+  warning naming the unanalyzed planes. Captures without a TPU plane
+  (CPU backend) fall back to the busiest ``tf_...`` executor thread line
+  so auto-analysis still names the hot thunks.
+  """
+  from tensor2robot_tpu.utils import xplane
+
+  warnings: List[str] = []
+  top_ops: List[Dict[str, object]] = []
+  occupancy = None
+  overlap = None
+  source = None
+  try:
+    families = xplane.op_families(xplane_path, n_steps=n_steps)
+    source = 'device'
+  except ValueError as e:
+    if 'matches' not in str(e):
+      raise
+    # Multi-chip capture: analyze exactly one plane, loudly.
+    plane_names = [name for name, _, _ in xplane.parse_xspace(xplane_path)
+                   if 'TPU' in name]
+    warnings.append('multi-plane capture ({}); analyzed {} only'.format(
+        ', '.join(plane_names), plane_names[0]))
+    families = xplane.op_families(xplane_path, n_steps=n_steps,
+                                  plane_substr=plane_names[0])
+    source = 'device'
+  stats = xplane.line_stats(xplane_path)
+  if not families:
+    # No TPU plane (CPU run): the executor thread lines hold the thunks.
+    executor = [s for s in stats if str(s['line']).startswith('tf_')]
+    if executor:
+      busiest = max(executor, key=lambda s: s['busy_ms'])
+      totals: Dict[str, float] = {}
+      for name, lines, metadata in xplane.parse_xspace(xplane_path):
+        if name != busiest['plane']:
+          continue
+        for line_name, events in lines:
+          if line_name != busiest['line']:
+            continue
+          for metadata_id, duration_ps, _ in events:
+            key = metadata.get(metadata_id, str(metadata_id))
+            totals[key] = totals.get(key, 0.0) + duration_ps / 1e9 / n_steps
+      families = sorted(totals.items(), key=lambda kv: -kv[1])
+      source = 'host_executor'
+      warnings.append('no TPU plane in capture; op times come from host '
+                      'executor line {!r}'.format(busiest['line']))
+  if families:
+    total_ms = sum(ms for _, ms in families)
+    top_ops = [{'name': name, 'ms_per_step': ms,
+                'fraction': (ms / total_ms) if total_ms else 0.0,
+                'source': source}
+               for name, ms in families[:top_k]]
+  # Occupancy of the analyzed serial line + host-vs-device overlap.
+  device_lines = [s for s in stats
+                  if (s['line'] == 'XLA Ops' and 'TPU' in str(s['plane']))
+                  or (source == 'host_executor'
+                      and str(s['line']).startswith('tf_'))]
+  if device_lines:
+    busiest = max(device_lines, key=lambda s: s['busy_ms'])
+    occupancy = dict(busiest)
+    host_lines = [s for s in stats if s['line'] == 'python']
+    if host_lines:
+      host = max(host_lines, key=lambda s: s['busy_ms'])
+      extent = max(busiest['extent_ms'], 1e-9)
+      overlap = {
+          'device_busy_ms': busiest['busy_ms'],
+          'device_extent_ms': busiest['extent_ms'],
+          # Device idle inside its own active window == time the host
+          # failed to keep it fed (dispatch gaps, data waits).
+          'device_idle_fraction': 1.0 - min(
+              busiest['busy_ms'] / extent, 1.0),
+          'host_line_events': host['events'],
+      }
+  if not top_ops:
+    warnings.append('capture held no attributable op events')
+  return top_ops, occupancy, overlap, warnings
+
+
+def attribute_goodput(fractions: Dict[str, float],
+                      scalars: Dict[str, float]
+                      ) -> List[Dict[str, object]]:
+  """Ranked non-productive goodput categories with evidence.
+
+  ``fractions`` from ``GoodputTracker.fractions()``; ``scalars`` from
+  ``TelemetryRegistry.scalars()`` — pure inputs so doctor can reuse this
+  on telemetry.jsonl records without a live registry.
+  """
+  out: List[Dict[str, object]] = []
+  lost = sorted(((cat, frac) for cat, frac in fractions.items()
+                 if cat != 'productive' and frac >= _ATTRIBUTION_FLOOR),
+                key=lambda kv: -kv[1])
+  for category, fraction in lost:
+    detail = ''
+    if category == 'data':
+      p95 = scalars.get('span/data.next/p95')
+      depths = [(tag, value) for tag, value in scalars.items()
+                if tag.startswith('data/prefetch_queue_depth')]
+      parts = []
+      if p95 is not None:
+        parts.append('span/data.next p95 {:.1f} ms'.format(p95))
+      if depths:
+        if all(value <= 0.0 for _, value in depths):
+          parts.append('prefetch queue empty at sample time: host decode '
+                       'is the bottleneck')
+        else:
+          parts.append('prefetch depth ' + ', '.join(
+              '{}={:g}'.format(tag.rsplit('/', 1)[-1], value)
+              for tag, value in depths))
+      detail = '; '.join(parts)
+    elif category == 'checkpoint':
+      p95 = scalars.get('span/ckpt.save/p95')
+      count = scalars.get('span/ckpt.save/count')
+      if p95 is not None:
+        detail = 'span/ckpt.save p95 {:.1f} ms over {:g} saves'.format(
+            p95, count or 0)
+    elif category == 'retry':
+      parts = []
+      for tag, label in (('reliability/nan_rollbacks', 'nan rollbacks'),
+                         ('reliability/preemptions', 'preemptions')):
+        value = scalars.get(tag, 0.0)
+        if value:
+          parts.append('{} {:g}'.format(label, value))
+      retries = sum(value for tag, value in scalars.items()
+                    if tag.startswith('reliability/io_retries'))
+      if retries:
+        parts.append('io retries {:g}'.format(retries))
+      detail = ', '.join(parts)
+    out.append({'category': category, 'fraction': fraction,
+                'detail': detail})
+  return out
+
+
+def build_report(step: int,
+                 reason: str = 'static',
+                 trigger: Optional[Dict[str, object]] = None,
+                 window: Optional[Dict[str, object]] = None,
+                 xplane_path: Optional[str] = None,
+                 n_steps: int = 1,
+                 hlo_text_fn: Optional[Callable[[], Optional[str]]] = None,
+                 goodput_fractions: Optional[Dict[str, float]] = None,
+                 counters_delta: Optional[Dict[str, float]] = None,
+                 registry: Optional[registry_lib.TelemetryRegistry] = None
+                 ) -> Dict[str, object]:
+  """Assembles the forensics report dict. Never raises: torn captures,
+  missing HLO, or reader bugs each degrade to a ``warnings`` entry."""
+  registry = registry or registry_lib.get_registry()
+  warnings: List[str] = []
+  report: Dict[str, object] = {
+      'schema': REPORT_SCHEMA,
+      'step': int(step),
+      'reason': reason,
+      'trigger': dict(trigger or {}),
+      'window': dict(window or {}),
+      'xplane_path': xplane_path,
+      'top_ops': [],
+      'device_occupancy': None,
+      'host_device_overlap': None,
+      'collectives': {},
+      'collective_bytes_total': 0,
+      'goodput': dict(goodput_fractions or {}),
+      'attribution': [],
+      'counters_delta': dict(counters_delta or {}),
+      'memory': {},
+      'warnings': warnings,
+  }
+  try:
+    scalars = registry.scalars()
+  except Exception as e:  # noqa: BLE001
+    scalars = {}
+    warnings.append('registry scalars unavailable: {}'.format(e))
+  if xplane_path is None:
+    warnings.append('no xplane capture found for this window')
+  else:
+    try:
+      top_ops, occupancy, overlap, op_warnings = _device_top_ops(
+          xplane_path, max(n_steps, 1), DEFAULT_TOP_K)
+      report['top_ops'] = top_ops
+      report['device_occupancy'] = occupancy
+      report['host_device_overlap'] = overlap
+      warnings.extend(op_warnings)
+    except Exception as e:  # noqa: BLE001 — torn/truncated capture
+      warnings.append('xplane analysis failed ({}: {}); raw capture kept '
+                      'at {}'.format(type(e).__name__, e, xplane_path))
+  if hlo_text_fn is not None:
+    try:
+      hlo_text = hlo_text_fn()
+      if hlo_text:
+        from tensor2robot_tpu.parallel import hlo_analysis
+        stats = hlo_analysis.collective_stats(hlo_text)
+        report['collectives'] = stats
+        report['collective_bytes_total'] = \
+            hlo_analysis.total_collective_bytes(stats)
+    except Exception as e:  # noqa: BLE001 — HLO is best-effort evidence
+      warnings.append('collective analysis failed: {}'.format(e))
+  try:
+    report['attribution'] = attribute_goodput(
+        report['goodput'], scalars)
+  except Exception as e:  # noqa: BLE001
+    warnings.append('goodput attribution failed: {}'.format(e))
+  report['memory'] = {tag: value for tag, value in scalars.items()
+                      if tag.startswith('memory/')}
+  return report
+
+
+def write_report(model_dir: str, step: int,
+                 report: Dict[str, object]) -> str:
+  """Atomically writes ``forensics/<step>.json``; returns the path."""
+  directory = os.path.join(model_dir, FORENSICS_DIRNAME)
+  os.makedirs(directory, exist_ok=True)
+  path = os.path.join(directory, '{}.json'.format(int(step)))
+  tmp = path + '.tmp'
+  with open(tmp, 'w', encoding='utf-8') as f:
+    json.dump(report, f, indent=2, sort_keys=True)
+  os.replace(tmp, path)
+  return path
+
+
+def read_reports(model_dir: str) -> List[Tuple[int, Dict[str, object]]]:
+  """All forensics reports under model_dir, sorted by step ascending.
+
+  Unreadable/malformed report files are skipped (a doctor run must not
+  die on one torn report), not raised.
+  """
+  directory = os.path.join(model_dir, FORENSICS_DIRNAME)
+  out: List[Tuple[int, Dict[str, object]]] = []
+  if not os.path.isdir(directory):
+    return out
+  for name in os.listdir(directory):
+    base, ext = os.path.splitext(name)
+    if ext != '.json':
+      continue
+    try:
+      step = int(base)
+      with open(os.path.join(directory, name), encoding='utf-8') as f:
+        out.append((step, json.load(f)))
+    except (ValueError, OSError):
+      continue
+  out.sort(key=lambda pair: pair[0])
+  return out

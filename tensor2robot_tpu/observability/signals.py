@@ -1,0 +1,173 @@
+"""New registry signal sources: XLA compile events + memory watermarks.
+
+Two classes of signals the PR 3 registry could not see:
+
+  * **Compilations.** ``jax.monitoring`` fires named events around every
+    jaxpr trace and backend (XLA) compile. One module-level dispatcher is
+    registered ONCE per process (jax's listener list has no unregister in
+    its public API) and routes into whatever ``get_registry()`` currently
+    is, gated by an enabled flag — so tests that swap registries or call
+    ``uninstall_jax_listeners`` need no private-API surgery. A silent
+    recompile mid-run (a shape-unstable batch reaching a jitted step) was
+    previously invisible until someone noticed the step-time graph; now
+    it is ``jax/compiles`` + ``jax/compile_ms`` landing in TensorBoard
+    and telemetry.jsonl, and the watchdog's ``recompile`` trigger.
+  * **Memory watermarks.** ``device.memory_stats()`` per accelerator
+    (None on CPU — skipped, not faked) and host RSS from /proc (fallback
+    ``resource.getrusage``), sampled by the trainer at its log cadence.
+    A monotonically climbing ``memory/device_bytes_in_use`` is the leak
+    signature the watchdog's ``hbm_growth`` detection consumes.
+
+Everything here degrades to a no-op on hosts without jax (the doctor CLI
+imports the observability package; it must stay jax-free), so jax is
+imported lazily and failures are swallowed where noted.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+from typing import Dict, Optional
+
+from tensor2robot_tpu.observability import registry as registry_lib
+
+__all__ = [
+    'COMPILE_COUNTER', 'COMPILE_MS_HISTOGRAM', 'TRACE_MS_HISTOGRAM',
+    'CACHE_MISS_COUNTER', 'HOST_RSS_GAUGE', 'HOST_PEAK_RSS_GAUGE',
+    'DEVICE_BYTES_GAUGE', 'DEVICE_PEAK_BYTES_GAUGE',
+    'install_jax_listeners', 'uninstall_jax_listeners', 'sample_memory',
+]
+
+COMPILE_COUNTER = 'jax/compiles'
+COMPILE_MS_HISTOGRAM = 'jax/compile_ms'
+TRACE_MS_HISTOGRAM = 'jax/trace_ms'
+CACHE_MISS_COUNTER = 'jax/compilation_cache_misses'
+
+HOST_RSS_GAUGE = 'memory/host_rss_bytes'
+HOST_PEAK_RSS_GAUGE = 'memory/host_peak_rss_bytes'
+DEVICE_BYTES_GAUGE = 'memory/device_bytes_in_use'
+DEVICE_PEAK_BYTES_GAUGE = 'memory/device_peak_bytes'
+
+# jax._src.dispatch event names (stable across 0.4.x; unknown events are
+# simply never matched, so a rename degrades to "no signal", not a crash).
+_BACKEND_COMPILE_EVENT = '/jax/core/compile/backend_compile_duration'
+_JAXPR_TRACE_EVENT = '/jax/core/compile/jaxpr_trace_duration'
+_CACHE_MISS_EVENT = '/jax/compilation_cache/cache_misses'
+
+_installed = False
+_enabled = False
+
+
+def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
+  if not _enabled:
+    return
+  registry = registry_lib.get_registry()
+  if event == _BACKEND_COMPILE_EVENT:
+    registry.counter(COMPILE_COUNTER).inc()
+    registry.histogram(
+        COMPILE_MS_HISTOGRAM,
+        bounds=registry_lib.DEFAULT_LATENCY_BUCKETS_MS).record(
+            duration_secs * 1e3)
+  elif event == _JAXPR_TRACE_EVENT:
+    registry.histogram(
+        TRACE_MS_HISTOGRAM,
+        bounds=registry_lib.DEFAULT_LATENCY_BUCKETS_MS).record(
+            duration_secs * 1e3)
+
+
+def _on_event(event: str, **kwargs) -> None:
+  if not _enabled:
+    return
+  if event == _CACHE_MISS_EVENT:
+    registry_lib.get_registry().counter(CACHE_MISS_COUNTER).inc()
+
+
+def install_jax_listeners() -> bool:
+  """Enables compile-event accounting; returns False on jax-free hosts.
+
+  Idempotent: the dispatcher is registered with jax.monitoring exactly
+  once per process; repeat calls only flip the enabled flag back on.
+  """
+  global _installed, _enabled
+  try:
+    from jax import monitoring
+  except Exception:  # noqa: BLE001 — jax-free host (doctor CLI)
+    return False
+  if not _installed:
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _installed = True
+  _enabled = True
+  return True
+
+
+def uninstall_jax_listeners() -> None:
+  """Disables the dispatcher (registration with jax remains; it is a
+  no-op while disabled). Test hook."""
+  global _enabled
+  _enabled = False
+
+
+def _host_rss_bytes() -> Optional[float]:
+  """Current resident set size; /proc first, portable-ish fallback."""
+  try:
+    with open('/proc/self/statm') as f:
+      pages = int(f.read().split()[1])
+    return float(pages * os.sysconf('SC_PAGE_SIZE'))
+  except (OSError, ValueError, IndexError):
+    pass
+  try:
+    # ru_maxrss is the PEAK (kilobytes on linux), not current — better
+    # than nothing on /proc-less hosts; the peak gauge below is exact.
+    return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+  except Exception:  # noqa: BLE001
+    return None
+
+
+def sample_memory(
+    registry: Optional[registry_lib.TelemetryRegistry] = None
+) -> Dict[str, float]:
+  """Samples device + host memory watermarks into gauges; returns them.
+
+  Device stats come from ``device.memory_stats()`` (PJRT; ``None`` on
+  the CPU backend — those devices are skipped so dashboards never show a
+  fake 0-byte TPU). Gauge names: ``memory/device_bytes_in_use/<device>``,
+  ``memory/device_peak_bytes/<device>``, ``memory/host_rss_bytes``,
+  ``memory/host_peak_rss_bytes``.
+  """
+  registry = registry or registry_lib.get_registry()
+  out: Dict[str, float] = {}
+  try:
+    import jax
+    devices = jax.devices()
+  except Exception:  # noqa: BLE001 — jax-free or uninitialized backend
+    devices = []
+  in_use = registry.gauge_family(DEVICE_BYTES_GAUGE, ('device',))
+  peak = registry.gauge_family(DEVICE_PEAK_BYTES_GAUGE, ('device',))
+  for device in devices:
+    try:
+      stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backend without the PJRT API
+      stats = None
+    if not stats:
+      continue
+    label = str(device.id)
+    value = float(stats.get('bytes_in_use', 0.0))
+    in_use.series(label).set(value)
+    out['{}/{}'.format(DEVICE_BYTES_GAUGE, label)] = value
+    peak_value = float(stats.get('peak_bytes_in_use', 0.0))
+    if peak_value:
+      peak.series(label).set(peak_value)
+      out['{}/{}'.format(DEVICE_PEAK_BYTES_GAUGE, label)] = peak_value
+  rss = _host_rss_bytes()
+  if rss is not None:
+    registry.gauge(HOST_RSS_GAUGE).set(rss)
+    out[HOST_RSS_GAUGE] = rss
+  try:
+    peak_rss = float(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+    registry.gauge(HOST_PEAK_RSS_GAUGE).set(peak_rss)
+    out[HOST_PEAK_RSS_GAUGE] = peak_rss
+  except Exception:  # noqa: BLE001
+    pass
+  return out

@@ -53,8 +53,10 @@ class TelemetryLogger:
   def log(self, kind: str, step: Optional[int] = None,
           **payload) -> Dict[str, object]:
     """Appends one record; returns it (tests and callers can reuse it)."""
-    record: Dict[str, object] = {'time': time.time(), 'kind': kind,
-                                 'step': None if step is None else int(step)}
+    record: Dict[str, object] = {
+        'time': time.time(),  # wall-clock timestamp (cross-process record)
+        'kind': kind,
+        'step': None if step is None else int(step)}
     record.update(payload)
     self._file.write(json.dumps(record) + '\n')
     return record
@@ -62,7 +64,7 @@ class TelemetryLogger:
   def heartbeat(self, step: Optional[int] = None, **extra) -> None:
     """Atomically replaces the heartbeat file (never half-written)."""
     beat: Dict[str, object] = {
-        'time': time.time(),
+        'time': time.time(),  # wall-clock timestamp (external readers)
         'step': None if step is None else int(step),
         'pid': os.getpid(),
         'hostname': socket.gethostname(),

@@ -20,6 +20,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AxisName = Union[str, Sequence[str]]
 
 
+def shard_map_compat(fn, mesh: Mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+  """``shard_map`` across jax versions.
+
+  jax >= 0.7 exposes ``jax.shard_map(..., check_vma=...)``; on 0.4.x the
+  same transform lives in ``jax.experimental.shard_map`` and the kwarg is
+  named ``check_rep``. Plain ``jax.shard_map`` attribute access on 0.4.x
+  raises (deprecation-gated), so probe with getattr.
+  """
+  top_level = getattr(jax, 'shard_map', None)
+  if top_level is not None:
+    return top_level(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=check_vma)
+  from jax.experimental.shard_map import shard_map
+
+  return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+
 def pmean(value, axis_name: AxisName):
   return lax.pmean(value, axis_name)
 
@@ -60,6 +79,6 @@ def sharded_fn(mesh: Mesh, in_specs, out_specs,
   Thin veneer over ``jax.shard_map`` so call sites read declaratively.
   """
   def decorator(fn):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=check_vma)
+    return shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=check_vma)
   return decorator

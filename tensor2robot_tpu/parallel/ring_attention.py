@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tensor2robot_tpu.parallel.collectives import shard_map_compat
+
 NEG_INF = -1e30
 
 
@@ -248,7 +250,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, seq_axis: str = 'data',
           'use_pallas requires per-device shard length ({}) divisible by '
           'the kernel block size.'.format(shard_len))
   spec = P(None, seq_axis, None, None)
-  fn = jax.shard_map(
+  fn = shard_map_compat(
       functools.partial(_ring_attention_shard, axis_name=seq_axis,
                         causal=causal, scale=scale, use_pallas=use_pallas),
       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

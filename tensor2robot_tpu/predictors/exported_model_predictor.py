@@ -78,8 +78,10 @@ class ExportedModelPredictor(AbstractPredictor):
         # whose serialization fell back to None can never serve model-less.
         fn_path = os.path.join(version_dir,
                                export_generators.PREDICT_FN_FILENAME)
+        from jax import export as jax_export  # stable module, jax>=0.4.30
+
         with open(fn_path, 'rb') as f:
-          exported_fn = jax.export.deserialize(f.read())
+          exported_fn = jax_export.deserialize(f.read())
       feature_spec, label_spec, step = assets_lib.load_t2r_assets_from_file(
           os.path.join(version_dir, assets_lib.EXTRA_ASSETS_DIRECTORY,
                        assets_lib.T2R_ASSETS_FILENAME))

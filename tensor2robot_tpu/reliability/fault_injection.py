@@ -11,6 +11,9 @@ Sites wired in this codebase (docs/reliability.md):
   * ``ckpt.restore``  CheckpointManager.restore, inside the retry loop
   * ``data.read``     tfrecord record reads → treated as a corrupt record
   * ``step.nan``      trainer train step → forces a non-finite loss
+  * ``step.slow``     trainer loop → host-side sleep inflating the step
+    time (``SLOW_STEP_SECONDS``), the symptom the observability
+    watchdog must catch (docs/observability.md)
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -27,9 +30,15 @@ SITE_CKPT_SAVE = 'ckpt.save'
 SITE_CKPT_RESTORE = 'ckpt.restore'
 SITE_DATA_READ = 'data.read'
 SITE_STEP_NAN = 'step.nan'
+SITE_STEP_SLOW = 'step.slow'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
-               SITE_STEP_NAN)
+               SITE_STEP_NAN, SITE_STEP_SLOW)
+
+# How long one fired 'step.slow' stalls the loop. Module-level (not per
+# armament) so tests tune it with a monkeypatch, matching the fixed
+# deterministic character of the injector.
+SLOW_STEP_SECONDS = 0.25
 
 
 class FaultInjector:
@@ -113,6 +122,14 @@ def fires(site: str) -> bool:
   if injector is not None:
     return injector.fires(site)
   return False
+
+
+def slow_step_seconds() -> float:
+  """Seconds the 'step.slow' site stalls THIS step; 0.0 when unarmed."""
+  injector = _INJECTOR
+  if injector is not None and injector.fires(SITE_STEP_SLOW):
+    return SLOW_STEP_SECONDS
+  return 0.0
 
 
 FaultSpec = Union[Dict[str, int], Sequence[Union[Tuple[str, int],

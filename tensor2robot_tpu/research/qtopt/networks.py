@@ -34,6 +34,25 @@ import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.pooling import max_pool
 
+@jax.custom_jvp
+def _schedule_barrier(x):
+  """``optimization_barrier`` that stays differentiable on jax 0.4.x.
+
+  The barrier is the identity — it only pins XLA scheduling — but older
+  jax ships no AD rule for it, and eval-mode activations still get
+  differentiated (e.g. actor gradients through a frozen Q-network, the
+  stem-rewrite parity tests). Tangents pass straight through; the
+  primal keeps the barrier, so the fusion guard holds wherever it runs.
+  """
+  return jax.lax.optimization_barrier(x)
+
+
+@_schedule_barrier.defjvp
+def _schedule_barrier_jvp(primals, tangents):
+  (x,), (dx,) = primals, tangents
+  return _schedule_barrier(x), dx
+
+
 NUM_LAYERS = 19
 BATCH_SIZE = 64
 # Action samples when estimating max_a Q(s, a) (ref :37-41).
@@ -150,7 +169,7 @@ class _PrePoolStatsBatchNorm(nn.Module):
     else:
       mean, var = ra_mean.value, ra_var.value
       # Same eval-mode fusion pathology guard as Grasping44Network._bn.
-      pooled = jax.lax.optimization_barrier(pooled)
+      pooled = _schedule_barrier(pooled)
     # Same arithmetic flax's BatchNorm applies: operands cast to the
     # module dtype first, normalize computed in that dtype.
     x = jnp.asarray(pooled, self.dtype)
@@ -201,7 +220,7 @@ class Grasping44Network(nn.Module):
       # native conv emitter to a loop fusion — measured 98 ms -> 33 ms
       # for the full eval forward at batch 256 with this barrier. The
       # barrier is the identity; numerics are untouched.
-      net = jax.lax.optimization_barrier(net)
+      net = _schedule_barrier(net)
     return nn.BatchNorm(
         use_running_average=not train, momentum=self.batch_norm_decay,
         epsilon=self.batch_norm_epsilon, use_scale=scale,

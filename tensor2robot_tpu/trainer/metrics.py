@@ -127,7 +127,8 @@ def _encode_event(step: int, values: Sequence[bytes] = (),
                   file_version: Optional[str] = None,
                   wall_time: Optional[float] = None) -> bytes:
   out = bytearray()
-  _emit_double_field(out, 1, time.time() if wall_time is None else wall_time)
+  # wall-clock timestamp: TensorBoard's event wall_time field.
+  _emit_double_field(out, 1, time.time() if wall_time is None else wall_time)  # wall-clock
   _emit_varint_field(out, 2, int(step))
   if file_version is not None:
     emit_bytes_field(out, 3, file_version.encode('utf-8'))
@@ -146,7 +147,7 @@ class MetricsWriter:
     os.makedirs(log_dir, exist_ok=True)
     self.log_dir = log_dir
     filename = 'events.out.tfevents.{:d}.{}'.format(
-        int(time.time()), socket.gethostname())
+        int(time.time()), socket.gethostname())  # wall-clock filename stamp
     self._writer = TFRecordWriter(os.path.join(log_dir, filename))
     self._writer.write(_encode_event(0, file_version='brain.Event:2'))
 

@@ -34,13 +34,17 @@ from tensor2robot_tpu.models.abstract_model import AbstractT2RModel, TrainState
 from tensor2robot_tpu.models.model_interface import ModelInterface
 from tensor2robot_tpu.modes import ModeKeys
 from tensor2robot_tpu.observability import (
+    AutoProfiler,
     GoodputTracker,
     TelemetryLogger,
+    Watchdog,
+    WatchdogConfig,
     get_registry,
-    set_trace_active,
     span,
 )
 from tensor2robot_tpu.observability import goodput as goodput_lib
+from tensor2robot_tpu.observability import signals as signals_lib
+from tensor2robot_tpu.observability import watchdog as watchdog_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import sharding as sharding_lib
 from tensor2robot_tpu.preprocessors.bfloat16_wrapper import (
@@ -109,6 +113,12 @@ class Trainer:
                write_metrics: bool = True,
                eval_name: Optional[str] = None,
                profile_steps: Optional[Sequence[int]] = None,
+               auto_profile: bool = True,
+               profile_budget: int = 2,
+               profile_window_steps: int = 5,
+               profile_min_interval_secs: float = 600.0,
+               enable_watchdog: bool = True,
+               watchdog_config: Optional[WatchdogConfig] = None,
                nan_policy: str = 'skip',
                nan_rollback_budget: int = 3,
                nan_check_every_n_steps: int = 1,
@@ -116,8 +126,18 @@ class Trainer:
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
     per-eval-run dirs, ref utils/train_eval.py:539-547).
-    profile_steps: (start, stop) global steps bracketing ONE
-    jax.profiler trace written under model_dir/plugins (SURVEY §5).
+    profile_steps: (start, stop) global steps bracketing ONE static
+    jax.profiler trace written under model_dir/plugins (SURVEY §5); the
+    window now also produces a forensics/<step>.json report.
+    auto_profile: let the watchdog trigger additional budgeted capture
+    windows when it detects an anomaly (docs/observability.md): at most
+    ``profile_budget`` triggered captures per run, each
+    ``profile_window_steps`` steps long, at least
+    ``profile_min_interval_secs`` (monotonic) apart.
+    enable_watchdog / watchdog_config: rolling-baseline anomaly
+    detection (step-time regression, goodput drop, recompiles, HBM
+    growth) at the log cadence; detections are counted, written to
+    telemetry.jsonl, and — with auto_profile — answered with a capture.
     nan_policy: what the non-finite-loss sentinel does
     (docs/reliability.md): 'skip' (default) discards the poisoned update
     on device — params/opt state keep their pre-step values, only the
@@ -157,14 +177,25 @@ class Trainer:
         quarantine_damaged=owns_checkpoint_dir)
     self._state_sharding = None
     self._train_step_fn = None
+    self._train_step_jitted = None  # the raw jit object (cache-size probe)
+    self._step_abstract = None  # ShapeDtypeStruct args for AOT relowering
     self._eval_step_fn = None
     self._predict_step_fn = None
     self._throughput = None  # (examples/sec, step_time_s) from last train run
     self.last_eval_state = None  # state used by the most recent evaluate()
     self._write_metrics = write_metrics
     self._eval_name = eval_name
-    self._profile_steps = tuple(profile_steps) if profile_steps else None
-    self._profiling = False
+    self._auto_profiler = AutoProfiler(
+        model_dir,
+        static_window=profile_steps,
+        window_steps=profile_window_steps,
+        max_captures=profile_budget if auto_profile else 0,
+        min_interval_secs=profile_min_interval_secs)
+    self._watchdog = (Watchdog(watchdog_config) if enable_watchdog
+                      else None)
+    # Compile-event accounting (jax/compiles, jax/compile_ms) feeds the
+    # watchdog's recompile detection; idempotent per process.
+    signals_lib.install_jax_listeners()
     if nan_policy not in NAN_POLICIES:
       raise ValueError('nan_policy must be one of {}; got {!r}.'.format(
           NAN_POLICIES, nan_policy))
@@ -178,14 +209,17 @@ class Trainer:
     self._device_feed = None
     self._device_feed_built = False
 
-  def _put_batch(self, batch: dict):
+  def _put_batch(self, batch: dict, channel: str = 'train'):
     """Host batch -> sharded device batch, sparse-coef aware.
 
     With a DeviceDecodePreprocessor(sparse=True) pipeline the input
     batches carry bucketed sparse DCT streams; the feed unpacks them to
     the fixed-shape dense coefficient tensors right after transfer so the
     jitted step never recompiles (data/device_feed.py). Everything else
-    is a plain shard_batch.
+    is a plain shard_batch. ``channel`` scopes the feed's shape-stability
+    accounting to the jitted program consuming the batch: the eval step
+    is its own compile, so its (legitimately different) batch shape must
+    not trip the train-step invariant.
     """
     if not self._device_feed_built:
       from tensor2robot_tpu.data.device_feed import SparseCoefFeed
@@ -194,7 +228,7 @@ class Trainer:
       self._device_feed_built = True
     if self._device_feed is None:
       return sharding_lib.shard_batch(batch, self.mesh)
-    return self._device_feed.put_batch(batch)
+    return self._device_feed.put_batch(batch, channel=channel)
 
   @property
   def train_metrics_writer(self):
@@ -224,30 +258,37 @@ class Trainer:
     """The GoodputTracker of the most recent train() call (or None)."""
     return self._last_goodput
 
-  def _maybe_profile(self, step_i: int) -> None:
-    """Starts/stops the one configured jax.profiler trace window."""
-    if self._profile_steps is None:
+  @property
+  def auto_profiler(self) -> AutoProfiler:
+    """The capture-window owner (static profile_steps + triggered)."""
+    return self._auto_profiler
+
+  @property
+  def watchdog(self) -> Optional[Watchdog]:
+    return self._watchdog
+
+  def _train_step_hlo(self) -> Optional[str]:
+    """Compiled-HLO text of the train step for forensics collective
+    stats. Relowers from the recorded abstract args (one extra XLA
+    compile — acceptable once per budgeted capture, never in the loop).
+    """
+    if self._train_step_jitted is None or self._step_abstract is None:
+      return None
+    return self._train_step_jitted.lower(
+        *self._step_abstract).compile().as_text()
+
+  def _sample_recompiles(self, registry) -> None:
+    """``recompiles/train_step``: the jitted step's executable-cache
+    size. Exactly 1 on a healthy run — the device_feed shape-stability
+    contract as a number; growth means some batch silently triggered a
+    full model recompile (the watchdog's ``recompile`` detection)."""
+    if self._train_step_jitted is None:
       return
-    start, stop = self._profile_steps
-    if not self._profiling and step_i >= start and step_i < stop:
-      try:
-        # start_trace appends plugins/profile/<run> itself — pass the
-        # logdir root so TensorBoard's profile plugin finds the trace.
-        jax.profiler.start_trace(self.model_dir)
-        self._profiling = True
-        # Spans now also emit TraceAnnotations, so the host-side seams
-        # (data.next, ckpt.save) show up as rows in this capture.
-        set_trace_active(True)
-      except Exception as e:  # noqa: BLE001 — profiling is best-effort
-        _log('Profiler unavailable: %s', e)
-        self._profile_steps = None
-    elif self._profiling and step_i >= stop:
-      jax.profiler.stop_trace()
-      set_trace_active(False)
-      self._profiling = False
-      self._profile_steps = None
-      _log('Profiler trace written to %s',
-           os.path.join(self.model_dir, 'plugins', 'profile'))
+    try:
+      size = self._train_step_jitted._cache_size()
+    except Exception:  # noqa: BLE001 — private probe; absent on old jax
+      return
+    registry.gauge(watchdog_lib.RECOMPILE_GAUGE).set(float(size))
 
   # -- state ---------------------------------------------------------------
 
@@ -372,8 +413,17 @@ class Trainer:
       # (tests, rl/offpolicy) keep the pre-reliability 4-arg signature.
       if force_nan is None:
         force_nan = np.asarray(False)
+      if self._step_abstract is None:
+        # Shape/dtype skeleton BEFORE the call (state is donated): lets
+        # forensics relower the exact compiled program without holding
+        # any buffers alive.
+        self._step_abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(jnp.shape(leaf),
+                                              jnp.result_type(leaf)),
+            (state, features, labels, base_rng, force_nan))
       return jitted(state, features, labels, base_rng, force_nan)
 
+    self._train_step_jitted = jitted
     self._train_step_fn = call
     return self._train_step_fn
 
@@ -478,6 +528,12 @@ class Trainer:
     registry.counter(quarantine_lib.FILES_ABANDONED_COUNTER)
     registry.counter('reliability/nan_rollbacks')
     registry.counter('reliability/preemptions')
+    registry.gauge(watchdog_lib.RECOMPILE_GAUGE)
+    # Forensics wiring: reports carry the live goodput split, and the
+    # collective stats come from relowering the step we just compiled.
+    self._auto_profiler.context_fn = \
+        lambda: {'goodput': tracker.fractions()}
+    self._auto_profiler.hlo_text_fn = self._train_step_hlo
     telemetry = self.telemetry_logger
     if telemetry is not None:
       telemetry.log('run_start', step=start_step,
@@ -505,7 +561,10 @@ class Trainer:
           # corruption budget, retry exhaustion — often the longest,
           # most interesting seconds) still lands in the accounting.
           try:
-            self._maybe_profile(step_i)
+            report_path = self._auto_profiler.maybe_profile(step_i)
+            if report_path is not None and telemetry is not None:
+              telemetry.log('forensics', step=step_i, report=report_path)
+              telemetry.flush()
             features, labels = batch
             with span('data.put_batch') as sp:
               device_batch = self._put_batch(
@@ -523,6 +582,12 @@ class Trainer:
               state, metrics = step_fn(state, device_batch['features'],
                                        device_batch['labels'], base_rng,
                                        force_nan)
+            # The 'step.slow' injection site: a host-side stall the
+            # watchdog must detect as a step-time regression — charged
+            # to productive time exactly like a real slowdown would be.
+            slow_s = fault_injection.slow_step_seconds()
+            if slow_s > 0.0:
+              time.sleep(slow_s)
             step_i += 1
             steps_since_log += 1
             # The sentinel also fires on every step that is about to be
@@ -552,10 +617,26 @@ class Trainer:
               metrics = jax.device_get(dict(metrics))
               dt = time.perf_counter() - t_last
               examples_per_sec = batch_size * steps_since_log / max(dt, 1e-9)
-              self._throughput = (examples_per_sec,
-                                  dt / max(steps_since_log, 1))
+              step_time_s = dt / max(steps_since_log, 1)
+              self._throughput = (examples_per_sec, step_time_s)
               _log('step %d: loss=%s (%.1f examples/sec)', step_i,
                    metrics.get('loss'), examples_per_sec)
+              # Performance-forensics sampling, BEFORE the exports so
+              # the same window's watermarks/anomaly counters land in
+              # this very TensorBoard write and telemetry record.
+              signals_lib.sample_memory(registry)
+              self._sample_recompiles(registry)
+              if self._watchdog is not None:
+                for anomaly in self._watchdog.observe(
+                    step_i, step_time_s, tracker.seconds()):
+                  _log('Watchdog anomaly: %s', anomaly.message)
+                  if telemetry is not None:
+                    telemetry.log('anomaly', step=step_i,
+                                  anomaly=anomaly.kind,
+                                  message=anomaly.message,
+                                  detail=anomaly.detail)
+                  self._auto_profiler.request_capture(
+                      anomaly.kind, step_i, anomaly.detail)
               writer = self.train_metrics_writer
               if writer is not None:
                 scalars = {k: float(np.mean(v)) for k, v in metrics.items()
@@ -572,12 +653,17 @@ class Trainer:
                 writer.write_scalars(step_i, scalars)
                 writer.flush()
               if telemetry is not None:
+                snapshot = registry.snapshot()
+                # Gauges ride along so offline tooling (doctor) can
+                # compute across SAMPLES — "prefetch queue empty in 81%
+                # of samples" needs the series, not the last value.
                 telemetry.log('train', step=step_i,
                               loss=_json_scalar(metrics.get('loss')),
                               examples_per_sec=examples_per_sec,
                               goodput=tracker.fractions(),
                               goodput_seconds=tracker.seconds(),
-                              counters=registry.snapshot()['counters'])
+                              counters=snapshot['counters'],
+                              gauges=snapshot['gauges'])
                 telemetry.heartbeat(step_i)
                 telemetry.flush()
               t_last = time.perf_counter()
@@ -610,16 +696,16 @@ class Trainer:
             commit_goodput(iter_start, data_s, ckpt_s, retry_s)
         completed = True
       finally:
-        # A dangling profiler trace breaks the next start_trace: stop it
-        # on EVERY exit path, not only clean completion.
-        if self._profiling:
-          try:
-            jax.profiler.stop_trace()
-          except Exception as e:  # noqa: BLE001 — already unwinding
-            _log('Profiler stop on failure path failed: %s', e)
-          set_trace_active(False)
-          self._profiling = False
-          self._profile_steps = None
+        # A dangling profiler trace breaks the next start_trace: close
+        # it on EVERY exit path. Clean completion gets the full
+        # forensics report; failure paths just stop the trace (the
+        # report machinery must never mask the unwinding exception).
+        if completed:
+          report_path = self._auto_profiler.finish(step_i)
+          if report_path is not None and telemetry is not None:
+            telemetry.log('forensics', step=step_i, report=report_path)
+        else:
+          self._auto_profiler.abort()
         if not completed:
           # NonFiniteLossError means ``state`` holds the NaN-poisoned
           # update ('raise', or 'rollback' with the budget exhausted) —
@@ -748,7 +834,8 @@ class Trainer:
       batch = None
       device_batch = self._put_batch(
           {'features': features.to_dict(),
-           'labels': labels.to_dict() if labels is not None else None})
+           'labels': labels.to_dict() if labels is not None else None},
+          channel='eval')
       metrics = jax.device_get(
           eval_fn(state, device_batch['features'], device_batch['labels']))
       for key, value in metrics.items():
@@ -802,7 +889,8 @@ class Trainer:
       device_batch = self._put_batch(
           {'features': raw_features.to_dict(),
            'labels': raw_labels.to_dict() if raw_labels is not None
-           else None})
+           else None},
+          channel='summary')
       features, labels, outputs = self._compile_summary_step()(
           state, device_batch['features'], device_batch['labels'])
       host = jax.device_get
@@ -920,7 +1008,8 @@ def train_eval_model(t2r_model: AbstractT2RModel,
                      eval_timeout_secs: float = 30.0,
                      write_metrics: bool = True,
                      eval_name: Optional[str] = None,
-                     profile_steps: Optional[Sequence[int]] = None
+                     profile_steps: Optional[Sequence[int]] = None,
+                     auto_profile: bool = True
                      ) -> Dict[str, Any]:
   """Main entry point (ref utils/train_eval.py:404).
 
@@ -951,6 +1040,7 @@ def train_eval_model(t2r_model: AbstractT2RModel,
       write_metrics=write_metrics,
       eval_name=eval_name,
       profile_steps=profile_steps,
+      auto_profile=auto_profile,
       # An eval-only job reads checkpoints a separate trainer process is
       # writing: it must never rename (quarantine) step dirs there.
       owns_checkpoint_dir=input_generator_train is not None)

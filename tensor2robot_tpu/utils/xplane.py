@@ -14,7 +14,8 @@ Wire schema subset (tensorflow/tsl profiler xplane.proto):
     XPlane  { string name = 2; repeated XLine lines = 3;
               map<int64, XEventMetadata> event_metadata = 4; }
     XLine   { string name = 2; repeated XEvent events = 4; }
-    XEvent  { int64 metadata_id = 1; int64 duration_ps = 3; }
+    XEvent  { int64 metadata_id = 1; int64 offset_ps = 2;
+              int64 duration_ps = 3; }
     XEventMetadata { string name = 2; }
 
 Typical use::
@@ -34,6 +35,7 @@ summing across them would multiply ms/step by the chip count).
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Dict, List, Tuple
 
@@ -45,19 +47,21 @@ _WIRE_VARINT = 0
 _WIRE_BYTES = 2
 
 
-def _parse_event(buf, start, end) -> Tuple[int, int]:
-  metadata_id = duration_ps = 0
+def _parse_event(buf, start, end) -> Tuple[int, int, int]:
+  metadata_id = offset_ps = duration_ps = 0
   for field, wire, value in _iter_fields(buf, start, end):
     if field == 1 and wire == _WIRE_VARINT:
       metadata_id = value
+    elif field == 2 and wire == _WIRE_VARINT:
+      offset_ps = value
     elif field == 3 and wire == _WIRE_VARINT:
       duration_ps = value
-  return metadata_id, duration_ps
+  return metadata_id, duration_ps, offset_ps
 
 
 def _parse_line(buf, start, end):
   name = ''
-  events: List[Tuple[int, int]] = []
+  events: List[Tuple[int, int, int]] = []
   for field, wire, value in _iter_fields(buf, start, end):
     if field == 2 and wire == _WIRE_BYTES:
       name = bytes(buf[value[0]:value[1]]).decode('utf-8', 'replace')
@@ -95,7 +99,8 @@ def _parse_plane(buf, start, end):
 
 
 def parse_xspace(path: str):
-  """[(plane_name, [(line_name, [(metadata_id, duration_ps)])], meta)]."""
+  """[(plane_name, [(line_name, [(metadata_id, duration_ps,
+  offset_ps)])], meta)]."""
   with open(path, 'rb') as f:
     buf = f.read()
   planes = []
@@ -123,7 +128,7 @@ def op_totals(path: str,
     for lname, events in lines:
       if lname != line_name:
         continue
-      for metadata_id, duration_ps in events:
+      for metadata_id, duration_ps, _ in events:
         key = metadata.get(metadata_id, str(metadata_id))
         totals[key] = totals.get(key, 0.0) + duration_ps / 1e9 / n_steps
     if totals:
@@ -135,6 +140,49 @@ def op_totals(path: str,
             plane_substr, len(matches), line_name,
             [name for name, _ in matches]))
   return matches[0][1] if matches else {}
+
+
+def line_stats(path: str) -> List[Dict[str, object]]:
+  """Per-line busy/extent/occupancy digest for every plane in a capture.
+
+  For each (plane, line) with at least one event::
+
+      {'plane': str, 'line': str, 'events': int,
+       'busy_ms':   sum of event durations,
+       'extent_ms': max(offset+duration) - min(offset),
+       'occupancy': busy_ms / extent_ms (0.0 when the extent is empty)}
+
+  ``occupancy`` is only meaningful for SERIAL lines (the TensorCore
+  ``XLA Ops`` line, a CPU executor thread): there it is the fraction of
+  the line's active window the device/thread was busy — the idle-gap
+  complement is what host-side stalls look like from the device.
+  Nested/overlapping lines (the host ``python`` line holds enclosing
+  TraceMes) can exceed 1.0; report, don't assert, on those.
+  """
+  out: List[Dict[str, object]] = []
+  for plane_name, lines, _ in parse_xspace(path):
+    for line_name, events in lines:
+      if not events:
+        continue
+      busy_ps = 0
+      lo = math.inf
+      hi = -math.inf
+      for _, duration_ps, offset_ps in events:
+        busy_ps += duration_ps
+        if offset_ps < lo:
+          lo = offset_ps
+        if offset_ps + duration_ps > hi:
+          hi = offset_ps + duration_ps
+      extent_ps = max(hi - lo, 0)
+      out.append({
+          'plane': plane_name,
+          'line': line_name,
+          'events': len(events),
+          'busy_ms': busy_ps / 1e9,
+          'extent_ms': extent_ps / 1e9,
+          'occupancy': (busy_ps / extent_ps) if extent_ps else 0.0,
+      })
+  return out
 
 
 _FAMILY_RE = re.compile(r'\.\d+$')

@@ -196,6 +196,7 @@ class TestVisualization:
 
 class TestGrasp2VecModel:
 
+  @pytest.mark.slow  # 30-170s on a 2-core CPU host: out of the tier-1 'not slow' budget
   def test_trains_and_embedding_arithmetic_shapes(self, tmp_path):
     """ResNet tower trains on the mesh; embeddings have matching dims."""
     model = grasp2vec.Grasp2VecModel(
@@ -234,6 +235,7 @@ class TestGrasp2VecModel:
 
 class TestEvalSummaries:
 
+  @pytest.mark.slow  # 30-170s on a 2-core CPU host: out of the tier-1 'not slow' budget
   def test_eval_writes_heatmap_images_and_histograms(self, tmp_path):
     """The model's add_summaries lands in the eval event files
     (the reference's add_summaries path, ref :224-245)."""

@@ -379,6 +379,7 @@ class TestFullFidelitySystems:
 
 class TestLearningDynamics:
 
+  @pytest.mark.slow  # 30-170s on a 2-core CPU host: out of the tier-1 'not slow' budget
   def test_critic_learns_action_conditional_rule(self, tmp_path):
     """Loss drops on a learnable synthetic rule: success == close_gripper.
 

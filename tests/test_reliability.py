@@ -466,7 +466,7 @@ class TestPreemptionAndCleanup:
                     hooks=[_RaiseAtStep(3, RuntimeError('boom'))])
     # The active trace was stopped on the failure path — a dangling trace
     # would make the next start_trace raise.
-    assert not trainer._profiling
+    assert not trainer.auto_profiler.active
     trainer.close()
     assert latest_checkpoint_step(model_dir) == 3
 

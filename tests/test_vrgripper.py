@@ -174,6 +174,7 @@ class TestRegressionModels:
     _train_two_steps(model, DefaultRandomInputGenerator(batch_size=8),
                      tmp_path)
 
+  @pytest.mark.slow  # 30-170s on a 2-core CPU host: out of the tier-1 'not slow' budget
   def test_maml_wrapper_trains(self, tmp_path):
     base = vrgripper.VRGripperRegressionModel(episode_length=3)
     maml = vrgripper.VRGripperEnvRegressionModelMAML(
@@ -184,6 +185,7 @@ class TestRegressionModels:
         num_inference_samples_per_task=1)
     _train_two_steps(maml, generator, tmp_path)
 
+  @pytest.mark.slow  # 30-170s on a 2-core CPU host: out of the tier-1 'not slow' budget
   def test_daml_learned_loss_adapts_policy_only(self, tmp_path):
     base = vrgripper.VRGripperDomainAdaptiveModel(episode_length=3)
     maml = vrgripper.VRGripperEnvRegressionModelMAML(

@@ -30,8 +30,9 @@ def _ld(number: int, payload: bytes) -> bytes:
   return _field(number, 2, _varint(len(payload)) + payload)
 
 
-def _event(metadata_id: int, duration_ps: int) -> bytes:
+def _event(metadata_id: int, duration_ps: int, offset_ps: int = 0) -> bytes:
   return (_field(1, 0, _varint(metadata_id)) +
+          _field(2, 0, _varint(offset_ps)) +
           _field(3, 0, _varint(duration_ps)))
 
 
@@ -44,9 +45,9 @@ def _synthetic_xspace(planes=('/device:TPU:0',)) -> bytes:
           _ld(2, _ld(2, name.encode())))
       for key, name in meta.items())
   line = (_ld(2, b'XLA Ops') +
-          _ld(4, _event(7, 3_000_000)) +      # 0.003 ms
-          _ld(4, _event(7, 1_000_000)) +
-          _ld(4, _event(9, 2_000_000)))
+          _ld(4, _event(7, 3_000_000, offset_ps=0)) +      # 0.003 ms
+          _ld(4, _event(7, 1_000_000, offset_ps=4_000_000)) +
+          _ld(4, _event(9, 2_000_000, offset_ps=6_000_000)))
   return b''.join(
       _ld(1, _ld(2, name.encode()) + _ld(3, line) + meta_entries)
       for name in planes)
@@ -98,6 +99,75 @@ class TestSyntheticDecode:
     import pytest
     with pytest.raises((ValueError, IndexError)):
       xplane.parse_xspace(path)
+
+
+class TestLineStats:
+
+  def test_busy_extent_occupancy(self, tmp_path):
+    path = str(tmp_path / 'test.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(_synthetic_xspace())
+    (stats,) = xplane.line_stats(path)
+    assert stats['plane'] == '/device:TPU:0'
+    assert stats['line'] == 'XLA Ops'
+    assert stats['events'] == 3
+    np.testing.assert_allclose(stats['busy_ms'], 0.006)
+    # Events span [0, 8_000_000) ps with a 1 µs gap at [3, 4) µs.
+    np.testing.assert_allclose(stats['extent_ms'], 0.008)
+    np.testing.assert_allclose(stats['occupancy'], 0.75)
+
+  def test_empty_capture_yields_no_lines(self, tmp_path):
+    path = str(tmp_path / 'test.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(b'')
+    assert xplane.line_stats(path) == []
+
+
+class TestForensicsDegradation:
+  """Torn/ambiguous captures through the AUTO-analysis path: the trainer
+  runs forensics.build_report inside its loop, so every fixture here must
+  come back as a partial report + warning, never an exception."""
+
+  def test_truncated_capture_partial_report(self, tmp_path):
+    from tensor2robot_tpu.observability import forensics
+    from tensor2robot_tpu.observability import registry as registry_lib
+
+    path = str(tmp_path / 'torn.xplane.pb')
+    payload = _synthetic_xspace()
+    with open(path, 'wb') as f:
+      f.write(payload[:len(payload) // 2])
+    report = forensics.build_report(
+        step=7, xplane_path=path, registry=registry_lib.TelemetryRegistry())
+    assert report['top_ops'] == []
+    assert any('xplane analysis failed' in w for w in report['warnings'])
+    assert path in ' '.join(report['warnings'])  # raw capture kept
+
+  def test_multi_plane_capture_analyzes_one_loudly(self, tmp_path):
+    from tensor2robot_tpu.observability import forensics
+    from tensor2robot_tpu.observability import registry as registry_lib
+
+    path = str(tmp_path / 'multi.xplane.pb')
+    with open(path, 'wb') as f:
+      f.write(_synthetic_xspace(planes=('/device:TPU:0', '/device:TPU:1')))
+    report = forensics.build_report(
+        step=7, n_steps=1, xplane_path=path,
+        registry=registry_lib.TelemetryRegistry())
+    # One plane analyzed (not chip_count x ms/step), named in a warning.
+    assert report['top_ops']
+    assert report['top_ops'][0]['name'] == '%convert_reduce_fusion'
+    np.testing.assert_allclose(report['top_ops'][0]['ms_per_step'], 0.004)
+    assert any('multi-plane capture' in w and '/device:TPU:0' in w
+               for w in report['warnings'])
+
+  def test_missing_capture_file_partial_report(self, tmp_path):
+    from tensor2robot_tpu.observability import forensics
+    from tensor2robot_tpu.observability import registry as registry_lib
+
+    report = forensics.build_report(
+        step=7, xplane_path=str(tmp_path / 'vanished.xplane.pb'),
+        registry=registry_lib.TelemetryRegistry())
+    assert report['top_ops'] == []
+    assert any('xplane analysis failed' in w for w in report['warnings'])
 
 
 class TestRealTrace:
