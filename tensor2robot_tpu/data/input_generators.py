@@ -332,12 +332,6 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       return iter(stream)
     if self._use_native is False or not native_loader.native_loader_enabled():
       return None
-    if self._dataset_map is not None:
-      if self._use_native is True:
-        raise ValueError(
-            'use_native=True but multi-dataset zip (dataset_map) is only '
-            'supported by the Python pipeline.')
-      return None  # multi-dataset zip stays on the Python path
     plan = native_loader.plan_for_specs(
         self._feature_spec, self._label_spec,
         sequence_max_len=self._sequence_max_len)
@@ -345,18 +339,32 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       if self._use_native is True:
         raise ValueError(
             'use_native=True but the specs are not supported by the native '
-            'loader (sequences without sequence_max_len, varlen, optional, '
-            'PNG, duplicate or unnamed feature names).')
+            'loader (sequences without sequence_max_len, PNG images, '
+            'duplicate or unnamed feature names).')
       return None
     try:
       # Through _dataset_files() so subclass overrides (e.g. Fractional's
-      # file_fraction truncation) apply to the native path too.
-      _, files = parse_file_patterns(self._dataset_files()[''])
-      files = files[shard_index::num_shards]
-      if not files:
+      # file_fraction truncation) apply to the native path too. One file
+      # list per dataset key: the native loader zips multi-dataset plans
+      # itself (record_loader.cc file groups).
+      files_by_key = {}
+      for key, patterns in self._dataset_files().items():
+        _, files = parse_file_patterns(patterns)
+        files = files[shard_index::num_shards]
+        if not files:
+          return None
+        files_by_key[key] = files
+      if set(plan.dataset_keys) != set(files_by_key):
+        # Specs reference dataset keys with no configured files (the
+        # Python path raises the clear error), OR the dataset_map names
+        # datasets no spec reads — the Python pipeline still ZIPS those
+        # (epoch ends at the shortest dataset), so the native path must
+        # not silently change epoch length/pairing by ignoring them.
         return None
+      stream_files = (files_by_key[''] if plan.dataset_keys == ['']
+                      else files_by_key)
       stream = native_loader.NativeBatchedStream(
-          plan, files, batch_size=self._batch_size,
+          plan, stream_files, batch_size=self._batch_size,
           shuffle=(mode == ModeKeys.TRAIN),
           shuffle_buffer=self._shuffle_buffer_size,
           num_epochs=num_epochs, seed=seed,

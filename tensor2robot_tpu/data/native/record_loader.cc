@@ -197,6 +197,20 @@ struct FieldSpec {
   // step CAPACITY; rows are [seq_cap, count] with zero padding past the
   // record's actual step count, which lands in buf_n.
   long long seq_cap = 0;
+  // Varlen (VarLenFeature semantics): the on-disk value list may hold
+  // any number of elements; the row is CLIPPED to ``count`` (extras
+  // dropped) or PADDED with ``pad_value`` (parser.py pad_or_clip
+  // parity). Float/int rank-1 fields and image_full frame lists only.
+  int varlen = 0;
+  double pad_value = 0.0;
+  // Optional (is_optional specs): a record may omit the feature. The
+  // per-row presence flag lands in buf_p; the Python side drops the key
+  // from any batch where presence is not all-ones (the Python parser's
+  // dense-batch drop semantics).
+  int optional_field = 0;
+  // Dataset index for multi-dataset zip: this field parses from the
+  // row's dsi-th record (one record per file group per row).
+  int dsi = 0;
   // Buffer indices into Slot::buffers (filled at config time).
   int buf0 = -1;            // primary (float/int/u8 pixels, coef Y, or
                             // sparse deltas)
@@ -204,6 +218,7 @@ struct FieldSpec {
                             // mode reuses buf_cb for values
   int buf_n = -1;           // per-row counts: sparse entry counts, or
                             // sequence step counts
+  int buf_p = -1;           // per-row presence flags (optional fields)
 };
 
 struct Config {
@@ -217,7 +232,10 @@ struct Config {
   bool verify_crc = false;
   bool any_seq = false;   // any sequence field: records parse as
                           // SequenceExample (context + feature_lists)
-  std::vector<std::string> files;
+  // One file list per dataset; row r of a batch is built from one record
+  // of EACH group (multi-dataset zip, ending with the shortest group).
+  // The single-dataset case is one group.
+  std::vector<std::vector<std::string>> groups;
   std::vector<FieldSpec> fields;
   std::vector<long long> buffer_sizes;  // per-slot bytes for each buffer
 };
@@ -234,22 +252,27 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
     else if (key == "seed") in >> cfg->seed;
     else if (key == "epochs") in >> cfg->epochs;
     else if (key == "verify_crc") { int v; in >> v; cfg->verify_crc = v != 0; }
-    else if (key == "files") {
+    else if (key == "files" || key == "group") {
+      // 'files N' (legacy single dataset) and 'group N' (one zip group
+      // per occurrence) both append one file group.
       int n; in >> n;
       in.ignore(1);
+      std::vector<std::string> group;
       for (int i = 0; i < n; i++) {
         std::string path;
         std::getline(in, path);
         if (path.empty()) { *err = "empty file path"; return false; }
-        cfg->files.push_back(path);
+        group.push_back(path);
       }
+      cfg->groups.push_back(std::move(group));
     } else if (key == "fields") {
       int m; in >> m;
       for (int i = 0; i < m; i++) {
         FieldSpec f;
         int kind, name_len;
         in >> name_len >> kind >> f.dtype_size >> f.h >> f.w >> f.c
-            >> f.count >> f.seq_cap;
+            >> f.count >> f.seq_cap >> f.varlen >> f.optional_field
+            >> f.dsi >> f.pad_value;
         f.kind = (FieldKind)kind;
         in.ignore(1);  // single separating space
         f.name.resize(name_len);
@@ -261,13 +284,39 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
       return false;
     }
   }
-  if (cfg->batch_size <= 0 || cfg->files.empty() || cfg->fields.empty()) {
-    *err = "config requires batch_size, files, fields";
+  if (cfg->batch_size <= 0 || cfg->groups.empty() || cfg->fields.empty()) {
+    *err = "config requires batch_size, files/groups, fields";
     return false;
+  }
+  for (const auto& g : cfg->groups) {
+    if (g.empty()) {  // an empty group would spin the zip reader on an
+                      // empty file list; reject at create like 'files 0'
+      *err = "empty file group";
+      return false;
+    }
+  }
+  for (const auto& f : cfg->fields) {
+    if (f.dsi < 0 || f.dsi >= (int)cfg->groups.size()) {
+      *err = "field dataset index out of range: " + f.name;
+      return false;
+    }
+    if (f.varlen && (f.seq_cap > 0 || f.kind == kImageCoef ||
+                     f.kind == kImageCoefSparse)) {
+      *err = "varlen unsupported for sequence/coef fields: " + f.name;
+      return false;
+    }
+    if (f.optional_field && (f.kind == kImageCoef ||
+                             f.kind == kImageCoefSparse)) {
+      *err = "optional unsupported for coef fields: " + f.name;
+      return false;
+    }
   }
   if (cfg->ring < 2) cfg->ring = 2;
   if (cfg->threads < 1) cfg->threads = 1;
-  // Assign buffers. Layout mirrored in native_loader.py (_field_buffers).
+  // shuffle_buffer <= 0 with shuffle on would never admit a record into
+  // the reservoir and end the stream empty; 1 degrades to pass-through.
+  if (cfg->shuffle_buffer < 1) cfg->shuffle_buffer = 1;
+  // Assign buffers. Layout mirrored in native_loader.py (_buffer_layout).
   long long B = cfg->batch_size;
   for (auto& f : cfg->fields) {
     if (f.seq_cap > 0) {
@@ -281,6 +330,10 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
       cfg->buffer_sizes.push_back(B * f.seq_cap * f.count * width);
       f.buf_n = (int)cfg->buffer_sizes.size();  // step counts, int32
       cfg->buffer_sizes.push_back(B * 4);
+      if (f.optional_field) {
+        f.buf_p = (int)cfg->buffer_sizes.size();  // presence, uint8
+        cfg->buffer_sizes.push_back(B);
+      }
       continue;
     }
     switch (f.kind) {
@@ -340,6 +393,10 @@ bool parse_config(const std::string& text, Config* cfg, std::string* err) {
         cfg->buffer_sizes.push_back(B * 4);
         break;
       }
+    }
+    if (f.optional_field) {
+      f.buf_p = (int)cfg->buffer_sizes.size();  // presence, uint8
+      cfg->buffer_sizes.push_back(B);
     }
   }
   return true;
@@ -673,7 +730,7 @@ struct Slot {
 };
 
 struct WorkItem {
-  std::string record;
+  std::vector<std::string> records;  // one record per dataset group
   int slot;
   int row;
 };
@@ -696,6 +753,13 @@ struct Loader {
   long long next_seq_out = 0;          // strict batch delivery order
   std::vector<std::thread> threads;
   std::thread reader;
+  // Worker/reader threads launch lazily on the FIRST next_slot() call,
+  // not at create time: create-time work is config parsing + buffer
+  // allocation only (errors surface synchronously), and every data/parse
+  // error has exactly ONE surfacing point — iteration. This is what
+  // makes error delivery deterministic instead of a race between the
+  // eagerly-parsing workers and the constructor's last_error poll.
+  std::once_flag launch_once;
 
   ~Loader() { shutdown(); }
 
@@ -728,8 +792,8 @@ struct Loader {
 
   // ---- reader ------------------------------------------------------------
 
-  bool dispatch_row(std::string&& rec, int* cur_slot, int* cur_row,
-                    long long* seq) {
+  bool dispatch_row(std::vector<std::string>&& recs, int* cur_slot,
+                    int* cur_row, long long* seq) {
     if (*cur_slot < 0) {  // acquire a free slot
       std::unique_lock<std::mutex> lk(mu);
       cv_free.wait(lk, [&] {
@@ -757,7 +821,7 @@ struct Loader {
         return stop || work.size() < (size_t)(4 * cfg.threads + 64);
       });
       if (stop) return false;
-      work.push_back(WorkItem{std::move(rec), *cur_slot, *cur_row});
+      work.push_back(WorkItem{std::move(recs), *cur_slot, *cur_row});
     }
     cv_work.notify_one();
     if (++*cur_row == cfg.batch_size) {
@@ -768,104 +832,159 @@ struct Loader {
     return true;
   }
 
+  // One dataset group's record source: its file list looped over the
+  // configured epochs, with the group's OWN bounded reservoir shuffle —
+  // the Python pipeline shuffles each zipped dataset independently
+  // before pairing (pipeline.py _record_tuples), so the native zip does
+  // too. reader_main pulls the groups in lockstep to form zip tuples
+  // (one record per group per row); the single-dataset case is one
+  // stream, where the per-stream reservoir is exactly the old
+  // emit-level one.
+  struct RecordStream {
+    Loader* loader = nullptr;
+    const std::vector<std::string>* files = nullptr;
+    std::mt19937_64* rng = nullptr;
+    long long epoch = 0;
+    size_t file_idx = 0;
+    std::vector<std::string> order;
+    FILE* f = nullptr;
+    long file_size = 0;
+    std::vector<std::string> shuffle_buf;
+    bool exhausted = false;
+
+    ~RecordStream() {
+      if (f) fclose(f);
+    }
+
+    // 1 = record read, 0 = clean end of data (or stop), -1 = error.
+    int next(std::string* rec, std::string* err) {
+      const Config& cfg = loader->cfg;
+      if (!cfg.shuffle) return read_raw(rec, err);
+      while (!exhausted &&
+             (int)shuffle_buf.size() < cfg.shuffle_buffer) {
+        std::string r;
+        int status = read_raw(&r, err);
+        if (status < 0) return -1;
+        if (status == 0) {
+          exhausted = true;
+          break;
+        }
+        shuffle_buf.push_back(std::move(r));
+      }
+      if (shuffle_buf.empty()) return 0;
+      size_t idx = (*rng)() % shuffle_buf.size();
+      std::swap(shuffle_buf[idx], shuffle_buf.back());
+      *rec = std::move(shuffle_buf.back());
+      shuffle_buf.pop_back();
+      return 1;
+    }
+
+    int read_raw(std::string* rec, std::string* err) {
+      const Config& cfg = loader->cfg;
+      for (;;) {
+        if (loader->stop.load()) return 0;
+        if (f == nullptr) {
+          if (order.empty() || file_idx >= order.size()) {
+            if (!order.empty()) epoch++;
+            if (cfg.epochs >= 0 && epoch >= cfg.epochs) return 0;
+            if (order.empty()) order = *files;
+            if (cfg.shuffle) std::shuffle(order.begin(), order.end(), *rng);
+            file_idx = 0;
+          }
+          const std::string& path = order[file_idx];
+          f = fopen(path.c_str(), "rb");
+          if (!f) {
+            *err = "cannot open " + path;
+            return -1;
+          }
+          fseek(f, 0, SEEK_END);
+          file_size = ftell(f);
+          fseek(f, 0, SEEK_SET);
+        }
+        const std::string& path = order[file_idx];
+        uint8_t header[12];
+        if (fread(header, 1, 12, f) != 12) {  // end of this file
+          fclose(f);
+          f = nullptr;
+          file_idx++;
+          continue;
+        }
+        uint64_t len;
+        memcpy(&len, header, 8);
+        // Sanity-cap the untrusted length BEFORE resize: a corrupt frame
+        // (or a non-TFRecord file matched by the glob) must surface as a
+        // loader error, not a std::bad_alloc escaping the thread.
+        long pos = ftell(f);
+        if (pos < 0 || len > (uint64_t)(file_size - pos)) {
+          *err = "corrupt or non-TFRecord frame in " + path +
+                 " (record length exceeds file size)";
+          return -1;
+        }
+        if (cfg.verify_crc) {
+          uint32_t expect;
+          memcpy(&expect, header + 8, 4);
+          if (masked_crc(header, 8) != expect) {
+            *err = "corrupt TFRecord length CRC in " + path;
+            return -1;
+          }
+        }
+        rec->resize(len);
+        if (len > 0 && fread(&(*rec)[0], 1, len, f) != len) {
+          *err = "truncated TFRecord in " + path;
+          return -1;
+        }
+        uint8_t footer[4];
+        if (fread(footer, 1, 4, f) != 4) {
+          *err = "truncated TFRecord in " + path;
+          return -1;
+        }
+        if (cfg.verify_crc) {
+          uint32_t expect;
+          memcpy(&expect, footer, 4);
+          if (masked_crc((const uint8_t*)rec->data(), rec->size()) !=
+              expect) {
+            *err = "corrupt TFRecord data CRC in " + path;
+            return -1;
+          }
+        }
+        return 1;
+      }
+    }
+  };
+
   void reader_main() {
     std::mt19937_64 rng(cfg.seed >= 0 ? (uint64_t)cfg.seed
                                       : std::random_device{}());
-    std::vector<std::string> shuffle_buf;
-    if (cfg.shuffle) shuffle_buf.reserve(cfg.shuffle_buffer);
     int cur_slot = -1, cur_row = 0;
     long long seq = 0;
 
-    auto emit = [&](std::string&& rec) -> bool {
-      if (!cfg.shuffle)
-        return dispatch_row(std::move(rec), &cur_slot, &cur_row, &seq);
-      shuffle_buf.push_back(std::move(rec));
-      if ((int)shuffle_buf.size() >= cfg.shuffle_buffer) {
-        size_t idx = rng() % shuffle_buf.size();
-        std::swap(shuffle_buf[idx], shuffle_buf.back());
-        std::string out = std::move(shuffle_buf.back());
-        shuffle_buf.pop_back();
-        return dispatch_row(std::move(out), &cur_slot, &cur_row, &seq);
-      }
-      return true;
-    };
-
-    long long epoch = 0;
-    std::vector<std::string> files = cfg.files;
-    while (cfg.epochs < 0 || epoch < cfg.epochs) {
-      if (cfg.shuffle)
-        std::shuffle(files.begin(), files.end(), rng);
-      for (const auto& path : files) {
-        FILE* f = fopen(path.c_str(), "rb");
-        if (!f) {
-          fail("cannot open " + path);
+    const size_t n_groups = cfg.groups.size();
+    std::vector<RecordStream> streams(n_groups);
+    for (size_t g = 0; g < n_groups; g++) {
+      streams[g].loader = this;
+      streams[g].files = &cfg.groups[g];
+      streams[g].rng = &rng;
+    }
+    for (;;) {
+      std::vector<std::string> tuple(n_groups);
+      bool end_of_data = false;
+      for (size_t g = 0; g < n_groups; g++) {
+        std::string err;
+        int status = streams[g].next(&tuple[g], &err);
+        if (status < 0) {
+          fail(err);
           return;
         }
-        fseek(f, 0, SEEK_END);
-        long file_size = ftell(f);
-        fseek(f, 0, SEEK_SET);
-        uint8_t header[12];
-        std::string rec;
-        while (fread(header, 1, 12, f) == 12) {
-          uint64_t len;
-          memcpy(&len, header, 8);
-          // Sanity-cap the untrusted length BEFORE resize: a corrupt frame
-          // (or a non-TFRecord file matched by the glob) must surface as a
-          // loader error, not a std::bad_alloc escaping the thread.
-          long pos = ftell(f);
-          if (pos < 0 || len > (uint64_t)(file_size - pos)) {
-            fclose(f);
-            fail("corrupt or non-TFRecord frame in " + path +
-                 " (record length exceeds file size)");
-            return;
-          }
-          if (cfg.verify_crc) {
-            uint32_t expect;
-            memcpy(&expect, header + 8, 4);
-            if (masked_crc(header, 8) != expect) {
-              fclose(f);
-              fail("corrupt TFRecord length CRC in " + path);
-              return;
-            }
-          }
-          rec.resize(len);
-          if (len > 0 && fread(&rec[0], 1, len, f) != len) {
-            fclose(f);
-            fail("truncated TFRecord in " + path);
-            return;
-          }
-          uint8_t footer[4];
-          if (fread(footer, 1, 4, f) != 4) {
-            fclose(f);
-            fail("truncated TFRecord in " + path);
-            return;
-          }
-          if (cfg.verify_crc) {
-            uint32_t expect;
-            memcpy(&expect, footer, 4);
-            if (masked_crc((const uint8_t*)rec.data(), rec.size()) != expect) {
-              fclose(f);
-              fail("corrupt TFRecord data CRC in " + path);
-              return;
-            }
-          }
-          if (!emit(std::move(rec))) {
-            fclose(f);
-            return;
-          }
-          rec.clear();
+        if (status == 0) {  // zip ends with the shortest dataset
+          end_of_data = true;
+          break;
         }
-        fclose(f);
-        if (stop) return;
       }
-      epoch++;
+      if (end_of_data) break;
+      if (!dispatch_row(std::move(tuple), &cur_slot, &cur_row, &seq))
+        return;
     }
-    // Flush shuffle buffer.
-    if (cfg.shuffle) {
-      std::shuffle(shuffle_buf.begin(), shuffle_buf.end(), rng);
-      for (auto& rec : shuffle_buf)
-        if (!dispatch_row(std::move(rec), &cur_slot, &cur_row, &seq)) return;
-    }
+    if (stop) return;
     // Partial batch at end of data is dropped (drop_remainder=True parity,
     // utils/tfdata.py:560-564): mark the half-filled slot free again.
     {
@@ -894,9 +1013,9 @@ struct Loader {
 
   // Walks one map entry ({1: key-bytes, 2: value-message}) shared by the
   // Features and FeatureLists sides. Returns the matched field index among
-  // fields whose (seq_cap > 0) equals ``sequence``, or -1; *value_out gets
-  // the value message cursor.
-  int match_entry(Cursor entry, bool sequence, Cursor* value_out) {
+  // fields of dataset ``dsi`` whose (seq_cap > 0) equals ``sequence``, or
+  // -1; *value_out gets the value message cursor.
+  int match_entry(Cursor entry, bool sequence, int dsi, Cursor* value_out) {
     const uint8_t* key_p = nullptr;
     size_t key_n = 0;
     Cursor value{nullptr, nullptr};
@@ -916,7 +1035,7 @@ struct Loader {
     // Linear scan: few fields, avoids hashing every record key.
     for (size_t i = 0; i < cfg.fields.size(); i++) {
       const FieldSpec& f = cfg.fields[i];
-      if ((f.seq_cap > 0) != sequence) continue;
+      if ((f.seq_cap > 0) != sequence || f.dsi != dsi) continue;
       if (f.name.size() == key_n &&
           memcmp(f.name.data(), key_p, key_n) == 0) {
         *value_out = value;
@@ -926,12 +1045,45 @@ struct Loader {
     return -1;
   }
 
-  std::string parse_into(const std::string& rec, int slot_idx, int row) {
-    Slot& slot = slots[slot_idx];
+  // Zeroes one row of an optional field that the record omitted. The
+  // Python side drops the whole key from any batch whose presence flags
+  // are not all-ones (the Python parser's dense-batch semantics), so the
+  // zeros are recycling hygiene, never observable data.
+  void zero_field_row(const FieldSpec& f, Slot& slot, int row) {
+    if (f.seq_cap > 0) {
+      int width = f.kind == kFloat ? 4 : f.dtype_size;
+      long long bytes = f.seq_cap * f.count * width;
+      memset(slot.buffers[f.buf0] + (long long)row * bytes, 0,
+             (size_t)bytes);
+      ((int32_t*)slot.buffers[f.buf_n])[row] = 0;
+      return;
+    }
+    switch (f.kind) {
+      case kFloat:
+        memset(slot.buffers[f.buf0] + (long long)row * f.count * 4, 0,
+               (size_t)(f.count * 4));
+        break;
+      case kInt:
+        memset(slot.buffers[f.buf0] +
+                   (long long)row * f.count * f.dtype_size,
+               0, (size_t)(f.count * f.dtype_size));
+        break;
+      case kImageFull: {
+        long long frames = f.count > 0 ? f.count : 1;
+        long long bytes = frames * (long long)f.h * f.w * f.c;
+        memset(slot.buffers[f.buf0] + (long long)row * bytes, 0,
+               (size_t)bytes);
+        break;
+      }
+      default:
+        break;  // coef modes cannot be optional (parse_config rejects)
+    }
+  }
+
+  std::string parse_record(const std::string& rec, int dsi, Slot& slot,
+                           int row, std::vector<bool>* found) {
     Cursor ex{(const uint8_t*)rec.data(),
               (const uint8_t*)rec.data() + rec.size()};
-    // Track which fields were found.
-    std::vector<bool> found(cfg.fields.size(), false);
     uint32_t wt;
     while (uint32_t fnum = ex.tag(&wt)) {
       if (fnum == 1 && wt == 2) {
@@ -943,9 +1095,10 @@ struct Loader {
             continue;
           }
           Cursor value{nullptr, nullptr};
-          int fi = match_entry(features.bytes(), /*sequence=*/false, &value);
+          int fi = match_entry(features.bytes(), /*sequence=*/false, dsi,
+                               &value);
           if (fi < 0) continue;
-          found[fi] = true;
+          (*found)[fi] = true;
           std::string err = extract_field(cfg.fields[fi], value, slot, row);
           if (!err.empty()) return err;
         }
@@ -958,9 +1111,10 @@ struct Loader {
             continue;
           }
           Cursor value{nullptr, nullptr};
-          int fi = match_entry(lists.bytes(), /*sequence=*/true, &value);
+          int fi = match_entry(lists.bytes(), /*sequence=*/true, dsi,
+                               &value);
           if (fi < 0) continue;
-          found[fi] = true;
+          (*found)[fi] = true;
           std::string err =
               extract_sequence_field(cfg.fields[fi], value, slot, row);
           if (!err.empty()) return err;
@@ -970,9 +1124,29 @@ struct Loader {
       }
     }
     if (!ex.ok) return "malformed Example record";
-    for (size_t i = 0; i < cfg.fields.size(); i++)
-      if (!found[i])
-        return "feature '" + cfg.fields[i].name + "' missing from record";
+    return "";
+  }
+
+  std::string parse_into(const std::vector<std::string>& recs, int slot_idx,
+                         int row) {
+    Slot& slot = slots[slot_idx];
+    // Track which fields were found across all zipped records.
+    std::vector<bool> found(cfg.fields.size(), false);
+    for (size_t d = 0; d < recs.size(); d++) {
+      std::string err = parse_record(recs[d], (int)d, slot, row, &found);
+      if (!err.empty()) return err;
+    }
+    for (size_t i = 0; i < cfg.fields.size(); i++) {
+      const FieldSpec& f = cfg.fields[i];
+      if (found[i]) {
+        if (f.buf_p >= 0) slot.buffers[f.buf_p][row] = 1;
+        continue;
+      }
+      if (!f.optional_field)
+        return "feature '" + f.name + "' missing from record";
+      if (f.buf_p >= 0) slot.buffers[f.buf_p][row] = 0;
+      zero_field_row(f, slot, row);
+    }
     return "";
   }
 
@@ -991,8 +1165,9 @@ struct Loader {
           if (f.kind != kImageFull && f.kind != kImageCoef &&
               f.kind != kImageCoefSparse)
             return "feature '" + f.name + "' is bytes but spec is numeric";
-          bool strict_list = f.kind == kImageFull && f.count > 0;
-          long long frames = strict_list ? f.count : 1;
+          bool frame_list = f.kind == kImageFull && f.count > 0;
+          bool strict_list = frame_list && !f.varlen;
+          long long frames = frame_list ? f.count : 1;
           long long got = 0;
           uint32_t wt2;
           while (uint32_t f2 = list.tag(&wt2)) {
@@ -1000,7 +1175,8 @@ struct Loader {
               Cursor payload = list.bytes();
               if (got >= frames) {
                 if (!strict_list) continue;  // rank-3 spec: first element
-                                             // wins, extras ignored
+                                             // wins; varlen list: clip —
+                                             // extras ignored either way
                                              // (Python parser parity)
                 char buf[128];
                 snprintf(buf, sizeof buf, "feature '%s': more than %lld "
@@ -1043,6 +1219,22 @@ struct Loader {
                      "frames, want %lld", f.name.c_str(), got, frames);
             return buf;
           }
+          if (f.varlen && frame_list && got < frames) {
+            // parser.py varlen-image parity: an EMPTY list decodes one
+            // all-zeros frame first, then pad_or_clip fills the rest
+            // with the varlen default value.
+            long long frame_bytes = (long long)f.h * f.w * f.c;
+            uint8_t* base = slot.buffers[f.buf0] +
+                            (size_t)row * frames * frame_bytes;
+            if (got == 0) {
+              memset(base, 0, (size_t)frame_bytes);
+              got = 1;
+            }
+            memset(base + got * frame_bytes,
+                   (uint8_t)(long long)f.pad_value,
+                   (size_t)((frames - got) * frame_bytes));
+            return "";
+          }
           if (got == 0) return "empty bytes list for '" + f.name + "'";
           return "";
         }
@@ -1066,7 +1258,9 @@ struct Loader {
     return "feature '" + f.name + "' has no value list";
   }
 
-  // FloatList message -> exactly f.count floats at ``out``.
+  // FloatList message -> exactly f.count floats at ``out``. Varlen
+  // fields instead CLIP extras and PAD a short list with f.pad_value
+  // (parser.py pad_or_clip_tensor_to_spec_shape parity).
   std::string parse_float_list(const FieldSpec& f, Cursor list, float* out) {
     long long got = 0;
     uint32_t wt2;
@@ -1075,13 +1269,22 @@ struct Loader {
       if (f2 == 1 && wt2 == 2) {
         Cursor packed = list.bytes();
         long long n = packed.size() / 4;
-        if (got + n > f.count)
-          return "too many floats for '" + f.name + "'";
+        if (got + n > f.count) {
+          if (!f.varlen)
+            return "too many floats for '" + f.name + "'";
+          n = f.count - got;  // clip
+        }
         memcpy(out + got, packed.p, n * 4);
         got += n;
+        if (f.varlen && got >= f.count) break;
       } else if (f2 == 1 && wt2 == 5) {
-        if (got >= f.count)
-          return "too many floats for '" + f.name + "'";
+        if (got >= f.count) {
+          if (!f.varlen)
+            return "too many floats for '" + f.name + "'";
+          list.p += 4;  // clip
+          if (list.p > list.end) list.p = list.end;
+          continue;
+        }
         if (list.end - list.p < 4)
           return "truncated float in '" + f.name + "'";
         memcpy(out + got, list.p, 4);
@@ -1090,6 +1293,11 @@ struct Loader {
       } else {
         list.skip(wt2);
       }
+    }
+    if (f.varlen) {
+      for (long long i = got; i < f.count; i++)
+        out[i] = (float)f.pad_value;
+      return "";
     }
     if (got != f.count) {
       char buf[128];
@@ -1100,7 +1308,8 @@ struct Loader {
     return "";
   }
 
-  // Int64List message -> exactly f.count ints at ``base``.
+  // Int64List message -> exactly f.count ints at ``base``; varlen fields
+  // clip/pad like parse_float_list.
   std::string parse_int_list(const FieldSpec& f, Cursor list, uint8_t* base) {
     long long got = 0;
     uint32_t wt2;
@@ -1117,15 +1326,27 @@ struct Loader {
         Cursor packed = list.bytes();
         while (packed.p < packed.end && got < f.count)
           store(packed.varint());
-        if (packed.p < packed.end)
-          return "too many ints for '" + f.name + "'";
+        if (packed.p < packed.end) {
+          if (!f.varlen)
+            return "too many ints for '" + f.name + "'";
+          while (packed.p < packed.end) packed.varint();  // clip
+        }
       } else if (f2 == 1 && wt2 == 0) {
-        if (got >= f.count)
-          return "too many ints for '" + f.name + "'";
+        if (got >= f.count) {
+          if (!f.varlen)
+            return "too many ints for '" + f.name + "'";
+          list.varint();  // clip
+          continue;
+        }
         store(list.varint());
       } else {
         list.skip(wt2);
       }
+    }
+    if (f.varlen) {
+      // np.full-style C cast of the (float) default into the int dtype.
+      while (got < f.count) store((uint64_t)(int64_t)f.pad_value);
+      return "";
     }
     if (got != f.count) {
       char buf[128];
@@ -1203,7 +1424,7 @@ struct Loader {
         work.pop_front();
       }
       cv_space.notify_one();
-      std::string err = parse_into(item.record, item.slot, item.row);
+      std::string err = parse_into(item.records, item.slot, item.row);
       Slot& slot = slots[item.slot];
       if (!err.empty()) {
         // Record the error but DEFER the fail/swallow decision to batch
@@ -1245,7 +1466,20 @@ struct Loader {
 
   // ---- consumer API ------------------------------------------------------
 
+  void ensure_launched() {
+    // Thread launch deferred from create to the first next_slot() call:
+    // all data/parse/decode errors then have ONE surfacing point
+    // (iteration), deterministically — see the launch_once field note.
+    std::call_once(launch_once, [this] {
+      if (stop.load()) return;  // config already failed at create
+      reader = std::thread([this] { reader_main(); });
+      for (int i = 0; i < cfg.threads; i++)
+        threads.emplace_back([this] { worker_main(); });
+    });
+  }
+
   int next_slot() {
+    ensure_launched();
     std::unique_lock<std::mutex> lk(mu);
     cv_ready.wait(lk, [&] {
       if (!error.empty()) return true;
@@ -1278,6 +1512,8 @@ struct Loader {
   }
 
   bool start(std::string* err) {
+    // Buffers only — threads launch on the first next_slot() call
+    // (ensure_launched), so create-time errors are config errors ONLY.
     slots.resize(cfg.ring);
     for (auto& s : slots) {
       for (long long sz : cfg.buffer_sizes) {
@@ -1289,9 +1525,6 @@ struct Loader {
         s.buffers.push_back((uint8_t*)p);
       }
     }
-    reader = std::thread([this] { reader_main(); });
-    for (int i = 0; i < cfg.threads; i++)
-      threads.emplace_back([this] { worker_main(); });
     return true;
   }
 };

@@ -7,11 +7,19 @@ ring of preallocated buffers. This module:
 
   * builds the shared library on first use (g++, cached by mtime);
   * decides, from a feature/label spec pair, whether the fast path supports
-    the dataset (``plan_for_specs``) — exotic specs (sequences, varlen,
-    optional tensors, multi-dataset zip, PNG) fall back to the pure-Python
-    :class:`~tensor2robot_tpu.data.parser.ExampleParser` pipeline;
+    the dataset (``plan_for_specs``). Since round 6 the fast path covers
+    sequences (given ``sequence_max_len``), varlen pad/clip, optional
+    features, and multi-dataset zip; the Python-parser fallback list is
+    PNG images only (plus structurally unparseable specs: unnamed or
+    duplicate feature names, object dtype);
   * exposes :class:`NativeBatchedStream`, an iterator of ``(features,
     labels)`` SpecStruct batches matching BatchedExampleStream's contract.
+
+Error delivery contract: creating a stream validates CONFIG only; the
+C++ reader/worker threads start on the first ``next()``, so every
+data-dependent error (missing file, corrupt record, decode failure,
+frame-count mismatch) surfaces at iteration — deterministically, never
+racing the constructor.
 
 Parity target: the reference's input hot path is TF's C++ tf.data runtime
 (/root/reference/utils/tfdata.py:527-575 — parallel_interleave + map with
@@ -105,7 +113,9 @@ class _Field:
 
   def __init__(self, key: str, spec: TensorSpec, kind: int,
                dtype_size: int, shape: Tuple[int, ...],
-               view_dtype, count: int = 0, seq_cap: int = 0):
+               view_dtype, count: int = 0, seq_cap: int = 0,
+               varlen: bool = False, optional: bool = False,
+               dsi: int = 0, pad_value: float = 0.0):
     self.key = key            # flat spec key ('state/image')
     self.spec = spec
     self.kind = kind
@@ -116,6 +126,18 @@ class _Field:
     # > 0: SequenceExample feature_lists field with this step capacity;
     # rows come back [seq_cap, *shape] zero-padded + a per-row length.
     self.seq_cap = seq_cap
+    # Varlen: on-disk value count may differ from the spec; the C++ side
+    # clips extras / pads shortfalls with ``pad_value`` (parser.py
+    # pad_or_clip semantics).
+    self.varlen = varlen
+    # Optional: records may omit the feature; a per-row presence buffer
+    # rides along and _pack drops the key from any batch that is not
+    # fully present (the Python parser's dense-batch semantics).
+    self.optional = optional
+    # Dataset index (multi-dataset zip): which zipped record this field
+    # parses from.
+    self.dsi = dsi
+    self.pad_value = pad_value
     # Images: last three dims are H, W, C (rank-4 specs carry a leading
     # frame count, which travels in ``count``).
     h, w, c = shape[-3:] if kind in (
@@ -125,18 +147,26 @@ class _Field:
 
   def config_line(self) -> str:
     name = self.spec.name.encode('utf-8')
-    return '{} {} {} {} {} {} {} {} {}'.format(
+    return '{} {} {} {} {} {} {} {} {} {} {} {:.17g} {}'.format(
         len(name), self.kind, self.dtype_size, self.h, self.w, self.c,
-        self.count, self.seq_cap, self.spec.name)
+        self.count, self.seq_cap, int(self.varlen), int(self.optional),
+        self.dsi, float(self.pad_value), self.spec.name)
 
 
 class NativeLoaderPlan:
-  """Eligibility + field layout for a (feature_spec, label_spec) pair."""
+  """Eligibility + field layout for a (feature_spec, label_spec) pair.
 
-  def __init__(self, fields: List[_Field], feature_spec, label_spec):
+  ``dataset_keys`` orders the zip groups: field ``dsi`` indexes into it,
+  and a stream built from this plan must provide one file list per key
+  (a plain list when the only key is '').
+  """
+
+  def __init__(self, fields: List[_Field], feature_spec, label_spec,
+               dataset_keys: Optional[List[str]] = None):
     self.fields = fields
     self.feature_spec = feature_spec
     self.label_spec = label_spec
+    self.dataset_keys = list(dataset_keys or [''])
 
 
 def coef_eligible(spec: TensorSpec) -> bool:
@@ -193,12 +223,29 @@ def plan_for_specs(feature_spec, label_spec,
   more steps fail with a clear error. Numeric (float/int) sequences only
   — bytes/JPEG steps fall back; derived ``<key>_length`` specs are
   produced by the stream, not read from disk.
+
+  Varlen specs (``varlen_default_value`` set) are native for rank-1
+  numeric tensors and rank-4 'full'-mode frame lists (clip/pad with the
+  default value — parser.py pad_or_clip parity); optional specs
+  (``is_optional``) are native everywhere except coef image modes, with
+  the Python parser's dense-batch semantics (a batch where ANY record
+  omits the feature drops the key). Specs with ``dataset_key`` plan as a
+  multi-dataset zip: the stream then takes one file list per key. The
+  remaining Python-parser fallbacks are PNG images and structurally
+  unparseable specs (unnamed/duplicate names, object dtype).
   """
   feature_spec = specs_lib.flatten_spec_structure(feature_spec)
   label_spec = specs_lib.flatten_spec_structure(label_spec)
   fields: List[_Field] = []
   seen_names = set()
-  for side, struct in (('features', feature_spec), ('labels', label_spec)):
+  sides = (('features', feature_spec), ('labels', label_spec))
+  dataset_keys = sorted({(struct[key].dataset_key or '')
+                         for _, struct in sides for key in struct
+                         if struct[key].name is not None})
+  if not dataset_keys:
+    return None
+  key_to_dsi = {k: i for i, k in enumerate(dataset_keys)}
+  for side, struct in sides:
     for key in struct:
       spec = struct[key]
       if (key.endswith('_length') and key[:-len('_length')] in struct
@@ -213,30 +260,34 @@ def plan_for_specs(feature_spec, label_spec,
         # and validate_and_pack would then raise on the missing keys every
         # batch. Fall back rather than fail downstream.
         return None
-      if (spec.is_optional
-          or spec.varlen_default_value is not None
-          or (spec.dataset_key or '')):
-        return None
+      optional = bool(spec.is_optional)
+      varlen = spec.varlen_default_value is not None
+      pad_value = float(spec.varlen_default_value or 0.0)
+      dsi = key_to_dsi[spec.dataset_key or '']
       shape = tuple(spec.shape or ())
       if any(s is None for s in shape):
         return None
       full_key = side + '/' + key
       if spec.is_sequence:
-        if not sequence_max_len or spec.is_encoded_image:
+        if not sequence_max_len or spec.is_encoded_image or varlen:
+          # Varlen sequences pad the BATCH dim with the default value in
+          # the Python parser — different semantics; keep them there.
           return None
         seen_names.add(spec.name)
         count = int(np.prod(shape)) if shape else 1
         if spec.dtype in (np.float32, bfloat16):
           fields.append(_Field(full_key, spec, _KIND_FLOAT, 4, shape,
                                np.float32, count,
-                               seq_cap=int(sequence_max_len)))
+                               seq_cap=int(sequence_max_len),
+                               optional=optional, dsi=dsi))
         elif spec.dtype in (np.int64, np.int32, np.uint8, np.bool_):
           size = {np.dtype(np.int64): 8, np.dtype(np.int32): 4,
                   np.dtype(np.uint8): 1, np.dtype(np.bool_): 1}[
                       np.dtype(spec.dtype)]
           fields.append(_Field(full_key, spec, _KIND_INT, size, shape,
                                spec.dtype, count,
-                               seq_cap=int(sequence_max_len)))
+                               seq_cap=int(sequence_max_len),
+                               optional=optional, dsi=dsi))
         else:
           return None
         continue
@@ -246,35 +297,50 @@ def plan_for_specs(feature_spec, label_spec,
         if len(shape) not in (3, 4) or spec.dtype != np.uint8 \
             or shape[-1] not in (1, 3):
           return None
+        if varlen and (image_mode != 'full' or len(shape) != 4):
+          return None  # varlen images are frame LISTS, full decode only
         if image_mode in ('coef', 'coef_sparse'):
-          if not coef_eligible(spec):
-            return None  # incl. rank-4: coef mode is single-frame only
+          if not coef_eligible(spec) or optional or varlen:
+            return None  # incl. rank-4: coef mode is single-frame only;
+                         # no presence/pad machinery on the coef buffers
           if image_mode == 'coef_sparse':
             fields.append(_Field(
                 full_key, spec, _KIND_IMAGE_COEF_SPARSE, 1, shape, np.int8,
-                count=sparse_capacity(spec, sparse_density)))
+                count=sparse_capacity(spec, sparse_density), dsi=dsi))
           else:
             fields.append(_Field(full_key, spec, _KIND_IMAGE_COEF, 1, shape,
-                                 np.int16))
+                                 np.int16, dsi=dsi))
         else:
-          # Rank-4 [T, H, W, C]: a fixed-length list of T encoded frames
-          # (episode data, e.g. seq2act); count carries T to the C++ side.
+          # Rank-4 [T, H, W, C]: a list of T encoded frames (episode
+          # data, e.g. seq2act) — strict count unless varlen (clip/pad);
+          # count carries T to the C++ side.
           frames = shape[0] if len(shape) == 4 else 0
           fields.append(_Field(full_key, spec, _KIND_IMAGE_FULL, 1, shape,
-                               np.uint8, count=frames))
+                               np.uint8, count=frames, varlen=varlen,
+                               optional=optional, dsi=dsi,
+                               pad_value=pad_value))
       elif spec.dtype == np.dtype(object):
         return None
       elif spec.dtype in (np.float32, bfloat16):
+        if varlen and len(shape) != 1:
+          return None  # parser pads/clips dim 0 of the FLAT list: only
+                       # rank-1 specs are well-defined
         count = int(np.prod(shape)) if shape else 1
         fields.append(_Field(full_key, spec, _KIND_FLOAT, 4, shape,
-                             np.float32, count))
+                             np.float32, count, varlen=varlen,
+                             optional=optional, dsi=dsi,
+                             pad_value=pad_value))
       elif spec.dtype in (np.int64, np.int32, np.uint8, np.bool_):
+        if varlen and len(shape) != 1:
+          return None
         size = {np.dtype(np.int64): 8, np.dtype(np.int32): 4,
                 np.dtype(np.uint8): 1, np.dtype(np.bool_): 1}[
                     np.dtype(spec.dtype)]
         count = int(np.prod(shape)) if shape else 1
         fields.append(_Field(full_key, spec, _KIND_INT, size, shape,
-                             spec.dtype, count))
+                             spec.dtype, count, varlen=varlen,
+                             optional=optional, dsi=dsi,
+                             pad_value=pad_value))
       else:
         return None
       seen_names.add(spec.name)
@@ -285,7 +351,8 @@ def plan_for_specs(feature_spec, label_spec,
   # went through add_sequence_length_specs).
   return NativeLoaderPlan(fields,
                           specs_lib.add_sequence_length_specs(feature_spec),
-                          specs_lib.add_sequence_length_specs(label_spec))
+                          specs_lib.add_sequence_length_specs(label_spec),
+                          dataset_keys=dataset_keys)
 
 
 class NativeBatchedStream:
@@ -298,7 +365,7 @@ class NativeBatchedStream:
   """
 
   def __init__(self, plan: NativeLoaderPlan,
-               filenames: Sequence[str],
+               filenames,
                batch_size: int,
                shuffle: bool = False,
                shuffle_buffer: int = 500,
@@ -310,6 +377,11 @@ class NativeBatchedStream:
                copy: bool = True,
                validate: bool = True,
                bucket_sparse: bool = True):
+    """``filenames``: a sequence of record paths, or — for a plan whose
+    specs carry ``dataset_key``s (multi-dataset zip) — a dict mapping
+    each of ``plan.dataset_keys`` to its file list; row r of every batch
+    is then assembled from one record of EACH dataset (zip ends with the
+    shortest), exactly like BatchedExampleStream's dataset_map path."""
     self._plan = plan
     self._batch_size = int(batch_size)
     self._copy = copy
@@ -321,6 +393,19 @@ class NativeBatchedStream:
     self._bucket_sparse = bool(bucket_sparse)
     self._lib = _lib()
     threads = num_threads or max(1, min(16, (os.cpu_count() or 2)))
+    if isinstance(filenames, dict):
+      missing = [k for k in plan.dataset_keys if k not in filenames]
+      if missing:
+        raise ValueError(
+            'filenames dict is missing dataset keys {} (plan expects '
+            '{}).'.format(missing, plan.dataset_keys))
+      file_groups = [list(filenames[k]) for k in plan.dataset_keys]
+    else:
+      if len(plan.dataset_keys) > 1:
+        raise ValueError(
+            'plan zips datasets {}; pass filenames as a dict keyed by '
+            'dataset key.'.format(plan.dataset_keys))
+      file_groups = [list(filenames)]
     lines = [
         'batch_size {}'.format(self._batch_size),
         'ring {}'.format(ring),
@@ -330,15 +415,20 @@ class NativeBatchedStream:
         'seed {}'.format(-1 if seed is None else seed),
         'epochs {}'.format(-1 if num_epochs is None else num_epochs),
         'verify_crc {}'.format(1 if verify_crc else 0),
-        'files {}'.format(len(filenames)),
     ]
-    lines.extend(filenames)
+    for group in file_groups:
+      lines.append('group {}'.format(len(group)))
+      lines.extend(group)
     lines.append('fields {}'.format(len(plan.fields)))
     lines.extend(f.config_line() for f in plan.fields)
     config = '\n'.join(lines).encode('utf-8')
     self._handle = self._lib.t2r_loader_create(config, len(config))
     if not self._handle:
       raise RuntimeError('native loader creation failed')
+    # Create-time errors are CONFIG errors only (parse/allocate run
+    # synchronously); the worker threads start lazily on the first
+    # next(), so data/decode errors surface at iteration — the one
+    # documented error-surfacing point.
     err = self._lib.t2r_loader_last_error(self._handle)
     if err:
       msg = err.decode('utf-8', 'replace')
@@ -364,6 +454,8 @@ class NativeBatchedStream:
         layout.extend([(f, 'sd'), (f, 'sv'), (f, 'qt'), (f, 'n')])
       else:
         layout.append((f, ''))
+      if f.optional:
+        layout.append((f, 'p'))  # per-row presence flags
     return layout
 
   def _build_views(self):
@@ -392,6 +484,9 @@ class NativeBatchedStream:
         elif sub == 'len':
           shape = (B,)
           dtype = np.int32
+        elif sub == 'p':
+          shape = (B,)
+          dtype = np.uint8
         elif sub == 'y':
           shape = (B, f.h // 8, f.w // 8, 64)
           dtype = np.int16
@@ -450,11 +545,18 @@ class NativeBatchedStream:
         lengths = self._views[slot][buf]
         seq_lengths[f.key] = lengths.astype(np.int64)
         seq_max[f.key] = max(1, int(lengths.max()))
+    # Optional fields: the Python parser drops a key from any batch where
+    # SOME record omitted it (a batch is dense). The C++ side reports
+    # per-row presence; a not-fully-present batch drops the key here.
+    dropped = set()
+    for buf, (f, sub) in enumerate(layout):
+      if sub == 'p' and not self._views[slot][buf].all():
+        dropped.add(f.key)
     by_key: Dict[str, np.ndarray] = {}
     for buf, (f, sub) in enumerate(layout):
       arr = self._views[slot][buf]
-      if sub == 'len':
-        continue  # emitted as <key>_length below
+      if sub in ('len', 'p') or f.key in dropped:
+        continue  # 'len' emitted as <key>_length below
       if sub in ('sd', 'sv'):
         # .copy(), NOT ascontiguousarray: when the bucket equals the full
         # capacity the slice is already contiguous and ascontiguousarray

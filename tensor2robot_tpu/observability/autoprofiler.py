@@ -262,7 +262,8 @@ class AutoProfiler:
         hlo_text_fn=self.hlo_text_fn,
         goodput_fractions=context.get('goodput'),
         counters_delta=counters_delta,
-        registry=self.registry)
+        registry=self.registry,
+        tuned_config=context.get('tuned_config'))
     path = forensics.write_report(self.model_dir, step, report)
     self.last_report_path = path
     _log('Forensics report: %s (top op: %s)', path,

@@ -213,10 +213,15 @@ def build_report(step: int,
                  hlo_text_fn: Optional[Callable[[], Optional[str]]] = None,
                  goodput_fractions: Optional[Dict[str, float]] = None,
                  counters_delta: Optional[Dict[str, float]] = None,
-                 registry: Optional[registry_lib.TelemetryRegistry] = None
+                 registry: Optional[registry_lib.TelemetryRegistry] = None,
+                 tuned_config: Optional[str] = None
                  ) -> Dict[str, object]:
   """Assembles the forensics report dict. Never raises: torn captures,
-  missing HLO, or reader bugs each degrade to a ``warnings`` entry."""
+  missing HLO, or reader bugs each degrade to a ``warnings`` entry.
+
+  ``tuned_config``: the active compile-config id (tuning/), or None for
+  the stock compile — carried verbatim so a step-time regression is
+  attributable to the config that compiled the step it profiled."""
   registry = registry or registry_lib.get_registry()
   warnings: List[str] = []
   report: Dict[str, object] = {
@@ -235,6 +240,7 @@ def build_report(step: int,
       'attribution': [],
       'counters_delta': dict(counters_delta or {}),
       'memory': {},
+      'tuned_config': tuned_config,
       'warnings': warnings,
   }
   try:

@@ -22,6 +22,7 @@ Counting rules:
 
 from __future__ import annotations
 
+import hashlib
 import re
 from typing import Dict
 
@@ -96,3 +97,26 @@ def format_stats(stats: Dict[str, Dict[str, int]]) -> str:
     return 'no collectives'
   return ', '.join('{}: {}x / {:.2f} MiB'.format(
       kind, v['count'], v['bytes'] / 2**20) for kind, v in stats.items())
+
+
+_MODULE_HEADER_RE = re.compile(r'^HloModule\s+\S+', re.MULTILINE)
+
+
+def program_fingerprint(compiled_or_text) -> str:
+  """Short stable sha1 of a compiled program's post-optimization HLO.
+
+  Accepts a compiled executable (``jit(f).lower(...).compile()``) or its
+  ``as_text()`` string. Comment lines and the HloModule header (which
+  carries a per-compile module id) are stripped so the digest depends
+  only on the optimized program itself. The compile-config autotuner
+  records this per candidate: two candidates with the SAME fingerprint
+  compiled to the SAME program, so their timing delta is noise and the
+  flag was a no-op for this workload — measured, not assumed.
+  """
+  text = compiled_or_text
+  if not isinstance(text, str):
+    text = compiled_or_text.as_text()
+  lines = [line.strip() for line in text.splitlines()
+           if line.strip() and not line.strip().startswith('//')]
+  body = _MODULE_HEADER_RE.sub('HloModule <normalized>', '\n'.join(lines))
+  return hashlib.sha1(body.encode('utf-8')).hexdigest()[:16]

@@ -132,6 +132,43 @@ class _StemConv(nn.Module):
     return out + (jax.lax.stop_gradient(bias) if train else bias)
 
 
+class _LayoutConv(nn.Module):
+  """A body conv computed under NCHW/OIHW ``dimension_numbers``.
+
+  Checkpoint-compatible with ``nn.Conv(use_bias=False)``: the parameter
+  is the same ``kernel`` of shape [k, k, in, out] with the same init —
+  only the CONV COMPUTATION runs through
+  ``dimension_numbers=('NCHW', 'OIHW', 'NCHW')`` (operand/kernel
+  transposed in-trace, result transposed back). Numerically this is the
+  same contraction in a different loop order; its point is to hand XLA's
+  layout assignment a different starting layout, one of the compile-
+  config autotuner's sweepable variants (tuning/search_space.py
+  'conv-nchw'). On the autotuner's sweep the transposes either fuse away
+  (and the variant measures what the layout is worth) or they don't (and
+  the candidate loses honestly).
+  """
+
+  features: int
+  kernel_size: int
+  stride: int = 1
+  padding: str = 'SAME'
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, x):
+    k = self.kernel_size
+    kernel = self.param('kernel',
+                        nn.initializers.truncated_normal(stddev=0.01),
+                        (k, k, x.shape[-1], self.features), jnp.float32)
+    x = jnp.asarray(x, self.dtype).transpose(0, 3, 1, 2)
+    kernel = jnp.asarray(kernel, self.dtype).transpose(3, 2, 0, 1)
+    out = jax.lax.conv_general_dilated(
+        x, kernel, (self.stride, self.stride), self.padding,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=self.dtype)
+    return out.transpose(0, 2, 3, 1)
+
+
 class _PrePoolStatsBatchNorm(nn.Module):
   """No-scale BatchNorm whose TRAIN statistics come from the pre-pool map.
 
@@ -194,10 +231,22 @@ class Grasping44Network(nn.Module):
   # Optional exact space-to-depth rewrite of the stem conv; see
   # _StemConv for the trade-off measurements.
   space_to_depth: bool = False
+  # Body-conv dimension_numbers/layout variant: 'nhwc' (stock nn.Conv)
+  # or 'nchw' (_LayoutConv — same params, NCHW/OIHW compute). Sweepable
+  # by the compile-config autotuner (tuning/search_space.py).
+  conv_variant: str = 'nhwc'
 
   def _conv(self, features, kernel, stride, padding, name):
     # BN-normalized convs carry NO bias, exactly like slim.conv2d under
     # the reference's normalizer_fn=batch_norm arg_scope (ref :441-446).
+    if self.conv_variant == 'nchw':
+      return _LayoutConv(features=features, kernel_size=kernel,
+                         stride=stride, padding=padding, dtype=self.dtype,
+                         name=name)
+    if self.conv_variant != 'nhwc':
+      raise ValueError(
+          "conv_variant must be 'nhwc' or 'nchw'; got {!r}.".format(
+              self.conv_variant))
     return nn.Conv(
         features=features, kernel_size=(kernel, kernel),
         strides=(stride, stride), padding=padding, use_bias=False,

@@ -4,8 +4,11 @@ from tensor2robot_tpu.rl.run_env import run_env
 from tensor2robot_tpu.rl.collect_eval import collect_eval_loop
 from tensor2robot_tpu.rl.offpolicy import (
     BellmanQTOptTrainer,
+    concat_ranking_pairs,
     pairwise_ranking_accuracy,
+    ranking_accuracy_from_scores,
 )
 
 __all__ = ['collect_eval_loop', 'run_env', 'BellmanQTOptTrainer',
-           'pairwise_ranking_accuracy']
+           'concat_ranking_pairs', 'pairwise_ranking_accuracy',
+           'ranking_accuracy_from_scores']

@@ -278,6 +278,57 @@ class BellmanQTOptTrainer:
     self.trainer.close()
 
 
+def concat_ranking_pairs(pairs):
+  """Concatenates every arm of (better, worse) pairs into ONE batch.
+
+  Returns ``(combined, arm_rows)``: a single feature dict with all arms
+  stacked along the batch dim in pair order (better0, worse0, better1,
+  worse1, ...), and the per-arm row counts needed to split scores back
+  out. Callers that evaluate on-device repeatedly (bench.py) concatenate
+  once, ``device_put`` the combined batch, and score each eval with
+  :func:`ranking_accuracy_from_scores`.
+  """
+  arms = [arm for pair in pairs for arm in pair]
+  if not arms:
+    return {}, []
+  keys = list(arms[0])
+  combined = {
+      k: np.concatenate([np.asarray(arm[k]) for arm in arms])
+      for k in keys
+  }
+  first = keys[0]
+  arm_rows = [int(np.asarray(arm[first]).shape[0]) for arm in arms]
+  return combined, arm_rows
+
+
+def ranking_accuracy_from_scores(scores, arm_rows) -> float:
+  """Fraction ranked correctly, from one score vector over all arms.
+
+  ``scores``: [sum(arm_rows)] critic outputs for a batch built by
+  :func:`concat_ranking_pairs`; consecutive (better, worse) arm slices
+  are compared elementwise.
+  """
+  scores = np.asarray(scores).ravel()
+  if scores.size != sum(arm_rows):
+    raise ValueError(
+        'Got {} scores for arms totalling {} rows — q_fn must return one '
+        'score per row.'.format(scores.size, sum(arm_rows)))
+  correct = total = 0
+  offset = 0
+  for i in range(0, len(arm_rows), 2):
+    rows_better, rows_worse = arm_rows[i], arm_rows[i + 1]
+    if rows_better != rows_worse:
+      raise ValueError(
+          'Pair {} has mismatched arm sizes {} vs {}.'.format(
+              i // 2, rows_better, rows_worse))
+    better = scores[offset:offset + rows_better]
+    worse = scores[offset + rows_better:offset + rows_better + rows_worse]
+    correct += int((better > worse).sum())
+    total += rows_better
+    offset += rows_better + rows_worse
+  return correct / max(total, 1)
+
+
 def pairwise_ranking_accuracy(q_fn, pairs) -> float:
   """Fraction of (features_better, features_worse) pairs ranked correctly.
 
@@ -285,18 +336,16 @@ def pairwise_ranking_accuracy(q_fn, pairs) -> float:
   two (state, action) feature dicts whose ground-truth Q* ordering is
   known with margin; ``q_fn(features) -> [B]`` is the live critic.
 
-  CAVEAT for critics with batch-statistics BN forwards: this helper runs
-  one forward PER ARM, and batch-stat normalization removes any feature
-  that is constant within a forward batch — an arm whose action columns
-  are constant would have exactly its action signal normalized away.
-  Such critics must be evaluated with both arms CONCATENATED in one
-  forward (see bench.py _bench_qtopt_offpolicy); this per-arm helper is
-  for BN-free models (tests) or running-average forwards.
+  Both arms of every pair are evaluated in ONE concatenated forward — by
+  construction, not by caller discipline. A per-arm forward would be
+  wrong for critics normalized with batch statistics: batch-stat BN
+  removes any feature that is constant within a forward batch, and each
+  arm of a ranking pair holds a constant action column — exactly the
+  signal being measured (the round-5 debugging find,
+  docs/round5_notes.md; regression-tested in tests/test_offpolicy.py
+  TestRankingAccuracyBatchStats).
   """
-  correct = total = 0
-  for better, worse in pairs:
-    q_better = np.asarray(q_fn(better)).ravel()
-    q_worse = np.asarray(q_fn(worse)).ravel()
-    correct += int((q_better > q_worse).sum())
-    total += q_better.size
-  return correct / max(total, 1)
+  combined, arm_rows = concat_ranking_pairs(pairs)
+  if not arm_rows:
+    return 0.0
+  return ranking_accuracy_from_scores(q_fn(combined), arm_rows)

@@ -122,7 +122,9 @@ class Trainer:
                nan_policy: str = 'skip',
                nan_rollback_budget: int = 3,
                nan_check_every_n_steps: int = 1,
-               owns_checkpoint_dir: bool = True):
+               owns_checkpoint_dir: bool = True,
+               tuned_config: Optional[Any] = None,
+               tuning_cache_path: Optional[str] = None):
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
     per-eval-run dirs, ref utils/train_eval.py:539-547).
@@ -152,6 +154,20 @@ class Trainer:
     training directory: their manager then never quarantines (renames)
     damaged step dirs out from under the owning trainer
     (checkpointing.CheckpointManager quarantine_damaged).
+    tuned_config: autotuned compile config for the train step
+    (docs/performance.md "Compile-config autotuner"). Accepts a
+    ``tuning.CompileConfig``, its dict form, or a WORKLOAD NAME string —
+    the string is looked up in the persistent config cache at first
+    compile, keyed by this step's actual shapes/dtypes + device_kind +
+    jax version, so a cache miss (never-tuned workload, changed batch
+    size, different chip) silently runs the stock compile. Only the
+    config's ``compiler_options`` apply here; ``model_overrides`` are
+    layout changes that must come in through the model constructor and
+    are ignored (logged) by this hook. The applied config id is exposed
+    as ``active_config_id`` and stamped into forensics reports so a
+    perf regression is attributable to the config that produced it.
+    tuning_cache_path: cache file for the string form (default:
+    tuning.default_cache_path()).
     """
     self.model = model
     self.model_dir = model_dir
@@ -208,6 +224,10 @@ class Trainer:
     self._last_goodput = None
     self._device_feed = None
     self._device_feed_built = False
+    self._tuned_config = tuned_config
+    self._tuning_cache_path = tuning_cache_path
+    self._train_step_compiled = None  # AOT executable under tuned options
+    self.active_config_id: Optional[str] = None
 
   def _put_batch(self, batch: dict, channel: str = 'train'):
     """Host batch -> sharded device batch, sparse-coef aware.
@@ -269,9 +289,17 @@ class Trainer:
 
   def _train_step_hlo(self) -> Optional[str]:
     """Compiled-HLO text of the train step for forensics collective
-    stats. Relowers from the recorded abstract args (one extra XLA
-    compile — acceptable once per budgeted capture, never in the loop).
+    stats. Under a tuned config the LIVE tuned executable's HLO is used
+    (the report is stamped with its id — analyzing a stock recompile
+    would attribute ops of a program that never ran); otherwise relowers
+    from the recorded abstract args (one extra XLA compile — acceptable
+    once per budgeted capture, never in the loop).
     """
+    if self._train_step_compiled is not None:
+      try:
+        return self._train_step_compiled.as_text()
+      except Exception:  # noqa: BLE001 — fall through to the relower
+        pass
     if self._train_step_jitted is None or self._step_abstract is None:
       return None
     return self._train_step_jitted.lower(
@@ -283,6 +311,11 @@ class Trainer:
     contract as a number; growth means some batch silently triggered a
     full model recompile (the watchdog's ``recompile`` detection)."""
     if self._train_step_jitted is None:
+      return
+    if self._train_step_compiled is not None:
+      # Tuned-config AOT path: exactly one executable exists by
+      # construction and the jit cache stays empty — report the healthy 1.
+      registry.gauge(watchdog_lib.RECOMPILE_GAUGE).set(1.0)
       return
     try:
       size = self._train_step_jitted._cache_size()
@@ -421,11 +454,106 @@ class Trainer:
             lambda leaf: jax.ShapeDtypeStruct(jnp.shape(leaf),
                                               jnp.result_type(leaf)),
             (state, features, labels, base_rng, force_nan))
+        self._apply_tuned_config(
+            jitted, (state, features, labels, base_rng, force_nan))
+      if self._train_step_compiled is not None:
+        return self._train_step_compiled(state, features, labels, base_rng,
+                                         force_nan)
       return jitted(state, features, labels, base_rng, force_nan)
 
     self._train_step_jitted = jitted
     self._train_step_fn = call
     return self._train_step_fn
+
+  def _resolve_tuned_config(self, args):
+    """tuned_config (CompileConfig | dict | workload-name str) ->
+    (config, from_cache).
+
+    The string form is the production hook: look the workload up in the
+    persistent tuning cache under THIS step's shapes/dtypes + device_kind
+    + jax version. A miss returns None — the trainer must run identically
+    with and without a cache entry. ``from_cache`` distinguishes a
+    cache-resolved winner from a directly-passed config: a direct
+    config's ``model_overrides`` were applied by the caller at model
+    construction (bench.py does), a cache-resolved one's were NOT.
+    """
+    from tensor2robot_tpu import tuning
+
+    spec = self._tuned_config
+    if spec is None:
+      return None, False
+    if isinstance(spec, tuning.CompileConfig):
+      return spec, False
+    if isinstance(spec, dict):
+      return tuning.CompileConfig.from_dict(spec), False
+    cache = tuning.ConfigCache(self._tuning_cache_path)
+    key = tuning.cache_key(
+        str(spec), tuning.abstract_signature(args),
+        getattr(jax.devices()[0], 'device_kind', 'unknown'))
+    entry = cache.lookup(key)
+    if entry is None:
+      _log('Tuning cache miss for workload %r (%s); using the stock '
+           'compile.', spec, key)
+      return None, True
+    if not entry.get('winner_ok', True):
+      # Every candidate failed when this workload was swept; the stored
+      # config is a placeholder, not a measured winner.
+      _log('Tuning cache entry for %r has no valid winner; using the '
+           'stock compile.', spec)
+      return None, True
+    return tuning.CompileConfig.from_dict(entry['winner']), True
+
+  def _apply_tuned_config(self, jitted, args) -> None:
+    """AOT-compiles the train step under the tuned compiler options.
+
+    Best-effort by contract: a stale cache entry naming a flag this
+    jaxlib rejects must cost a log line and fall back to the stock
+    compile, never the training run. ``active_config_id`` is set only
+    when the config actually took effect.
+    """
+    try:
+      config, from_cache = self._resolve_tuned_config(args)
+    except Exception as e:  # noqa: BLE001 — cache I/O must never kill train
+      _log('Tuned-config resolution failed (%s); using stock compile.', e)
+      return
+    if config is None:
+      return
+    if config.model_overrides:
+      if from_cache:
+        # The measured winner included layout overrides, which apply only
+        # at model construction; compiling just its flags here would run
+        # an unmeasured hybrid attributed to the winner's id. Stock
+        # compile instead — same refusal-to-misattribute as the
+        # overrides-only guard below.
+        _log('Tuned config %s from the cache carries model_overrides %s '
+             'which cannot apply at compile time; using the stock '
+             'compile. Apply the overrides at model construction and '
+             'pass the config directly to use this winner.',
+             config.config_id, sorted(config.model_overrides))
+        return
+      _log('Tuned config %s carries model_overrides %s — layout changes '
+           'apply at model construction, not here; ignoring them.',
+           config.config_id, sorted(config.model_overrides))
+    if not config.compiler_options:
+      # Overrides-only config: attributable only when the CALLER applied
+      # the overrides at model construction (direct form). A
+      # cache-resolved one took no effect here — stamping its id would
+      # attribute runs to a config that never applied.
+      if not from_cache:
+        self.active_config_id = config.config_id
+      return
+    from tensor2robot_tpu.tuning import autotuner
+    try:
+      with span('train.tuned_compile'):
+        self._train_step_compiled = autotuner.compile_with_config(
+            jitted, args, config)
+      self.active_config_id = config.config_id
+      _log('Train step compiled under tuned config %s (%s).',
+           config.config_id, config.compiler_options)
+    except Exception as e:  # noqa: BLE001 — unknown flag on this backend
+      self._train_step_compiled = None
+      _log('Tuned config %s failed to compile (%s); using stock compile.',
+           config.config_id, e)
 
   def _compile_eval_step(self):
     if self._eval_step_fn is not None:
@@ -529,10 +657,12 @@ class Trainer:
     registry.counter('reliability/nan_rollbacks')
     registry.counter('reliability/preemptions')
     registry.gauge(watchdog_lib.RECOMPILE_GAUGE)
-    # Forensics wiring: reports carry the live goodput split, and the
-    # collective stats come from relowering the step we just compiled.
+    # Forensics wiring: reports carry the live goodput split plus the
+    # active tuned-config id (attributable perf), and the collective
+    # stats come from relowering the step we just compiled.
     self._auto_profiler.context_fn = \
-        lambda: {'goodput': tracker.fractions()}
+        lambda: {'goodput': tracker.fractions(),
+                 'tuned_config': self.active_config_id}
     self._auto_profiler.hlo_text_fn = self._train_step_hlo
     telemetry = self.telemetry_logger
     if telemetry is not None:
@@ -1009,7 +1139,8 @@ def train_eval_model(t2r_model: AbstractT2RModel,
                      write_metrics: bool = True,
                      eval_name: Optional[str] = None,
                      profile_steps: Optional[Sequence[int]] = None,
-                     auto_profile: bool = True
+                     auto_profile: bool = True,
+                     tuned_config: Optional[Any] = None
                      ) -> Dict[str, Any]:
   """Main entry point (ref utils/train_eval.py:404).
 
@@ -1041,6 +1172,7 @@ def train_eval_model(t2r_model: AbstractT2RModel,
       eval_name=eval_name,
       profile_steps=profile_steps,
       auto_profile=auto_profile,
+      tuned_config=tuned_config,
       # An eval-only job reads checkpoints a separate trainer process is
       # writing: it must never rename (quarantine) step dirs there.
       owns_checkpoint_dir=input_generator_train is not None)

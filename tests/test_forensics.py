@@ -392,7 +392,17 @@ class TestForensicsLoop:
   def test_clean_run_triggers_nothing_and_counts_one_compile(
       self, tmp_path, fresh_registry):
     model_dir = str(tmp_path)
-    trainer = _make_trainer(model_dir, log_every_n_steps=2)
+    # Jitter-proof thresholds: the windows here are 2 millisecond-scale
+    # mock steps, so one OS scheduling transient exceeds the production
+    # 1.8x ratio and flips this test (observed ~1-in-3 under ambient
+    # load on a 2-core container). 10x/0.9 still fail loudly on any
+    # genuine anomaly — the injected-slowdown test above fires at ~50x
+    # under the PRODUCTION defaults, so the clean/dirty asymmetry keeps
+    # its teeth.
+    trainer = _make_trainer(
+        model_dir, log_every_n_steps=2,
+        watchdog_config=obs.WatchdogConfig(regression_ratio=10.0,
+                                           goodput_drop=0.9))
     trainer.train(MockInputGenerator(batch_size=8), max_train_steps=10)
     trainer.close()
     assert trainer.auto_profiler.captures_taken == 0

@@ -104,8 +104,21 @@ def test_async_export_hook_builder_in_train_eval(tmp_path):
 
 def test_variable_logger_hook(tmp_path, caplog):
   import logging
+
+  import absl.logging as absl_logging
+
   hook = VariableLoggerHook(log_every_n_steps=1, log_values=True)
-  with caplog.at_level(logging.INFO):
-    _train(str(tmp_path / 'run'), hooks=[hook], steps=2)
+  # Pin absl verbosity for the test: importing tensorflow ANYWHERE in the
+  # process (e.g. test_tf_savedmodel in a prior in-process pass) drops it
+  # to WARNING globally, which silently filters the hook's INFO lines
+  # before they reach caplog — an order-dependent flake caught by
+  # bin/check_order_clean.
+  old_verbosity = absl_logging.get_verbosity()
+  absl_logging.set_verbosity(absl_logging.INFO)
+  try:
+    with caplog.at_level(logging.INFO):
+      _train(str(tmp_path / 'run'), hooks=[hook], steps=2)
+  finally:
+    absl_logging.set_verbosity(old_verbosity)
   # absl routes into the python logging root; assert we logged variables.
   assert any('var ' in r.message for r in caplog.records)

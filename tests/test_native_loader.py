@@ -73,22 +73,44 @@ class TestPlan:
     assert native_loader.plan_for_specs(features, labels,
                                         sequence_max_len=8) is not None
 
-  def test_optional_ineligible(self):
+  def test_optional_eligible(self):
     features, labels = _specs()
     features.opt = TensorSpec((4,), np.float32, name='opt', is_optional=True)
-    assert native_loader.plan_for_specs(features, labels) is None
+    assert native_loader.plan_for_specs(features, labels) is not None
 
   def test_png_ineligible(self):
+    # PNG is the ONE remaining image fallback to the Python parser.
     features, labels = _specs()
     features.image = TensorSpec((48, 64, 3), np.uint8, name='img/encoded',
                                 data_format='png')
     assert native_loader.plan_for_specs(features, labels) is None
 
-  def test_varlen_ineligible(self):
+  def test_varlen_eligible(self):
+    # Rank-1 numeric varlen (TensorSpec enforces rank-1 for non-image
+    # varlen) and rank-4 varlen frame lists are both native now.
     features, labels = _specs()
     features.v = TensorSpec((4,), np.float32, name='v',
                             varlen_default_value=0.0)
-    assert native_loader.plan_for_specs(features, labels) is None
+    assert native_loader.plan_for_specs(features, labels) is not None
+    features.clips = TensorSpec((3, 48, 64, 3), np.uint8, name='clips',
+                                data_format='jpeg',
+                                varlen_default_value=0.0)
+    assert native_loader.plan_for_specs(features, labels) is not None
+
+  def test_dataset_zip_eligible(self):
+    features, labels = _specs()
+    features.other = TensorSpec((2,), np.float32, name='other',
+                                dataset_key='aux')
+    plan = native_loader.plan_for_specs(features, labels)
+    assert plan is not None
+    assert plan.dataset_keys == ['', 'aux']
+
+  def test_optional_ineligible_in_coef_mode(self):
+    features, labels = _specs()
+    features.image = TensorSpec((48, 64, 3), np.uint8, name='img/encoded',
+                                data_format='jpeg', is_optional=True)
+    assert native_loader.plan_for_specs(
+        features, labels, image_mode='coef') is None
 
   def test_coef_requires_mcu_aligned_dims(self):
     features, labels = _specs()
@@ -143,6 +165,15 @@ class TestNativeStream:
     assert not all(
         np.array_equal(fa['scalar'], fc['scalar'])
         for (fa, _), (fc, _) in zip(a, c))
+
+  def test_shuffle_buffer_zero_degrades_to_pass_through(self, record_file):
+    # shuffle on with shuffle_buffer <= 0 must clamp to 1 (pass-through),
+    # not silently end the stream empty before a single record is
+    # admitted to the reservoir.
+    path, _, _ = record_file
+    batches = self._native_batches(path, 4, num_epochs=1, shuffle=True,
+                                   shuffle_buffer=0)
+    assert len(batches) == 2
 
   def test_zero_copy_views_valid_for_one_step(self, record_file):
     path, _, _ = record_file
@@ -253,6 +284,192 @@ class TestNativeStream:
     finally:
       stream.close()
     assert np.asarray(feats['x']).dtype == bfloat16
+
+
+class TestVarlenOptionalZip:
+  """Wire parity for the round-6 fast paths: varlen pad/clip, optional
+  presence (dense-batch drop), and multi-dataset zip — the Python
+  ExampleParser is the semantic oracle, byte-for-byte."""
+
+  def test_varlen_rank1_pad_clip_parity(self, tmp_path):
+    path = str(tmp_path / 'varlen.tfrecord')
+    features = SpecStruct(
+        v=TensorSpec((4,), np.float32, name='v', varlen_default_value=7.0),
+        i=TensorSpec((3,), np.int64, name='i', varlen_default_value=-1))
+    rng = np.random.RandomState(0)
+    records = []
+    for count_v, count_i in [(2, 3), (4, 1), (6, 5), (0, 0)]:
+      records.append(build_example({
+          'v': rng.rand(count_v).astype(np.float32),
+          'i': np.arange(count_i, dtype=np.int64)}))
+    tfrecord.write_records(path, records)
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    assert plan is not None
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=4,
+                                               num_epochs=1)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    ref, _ = ExampleParser(features, SpecStruct()).parse_batch(records)
+    for key in ('v', 'i'):
+      np.testing.assert_array_equal(np.asarray(feats[key]),
+                                    np.asarray(ref[key]), err_msg=key)
+      assert feats[key].dtype == ref[key].dtype, key
+
+  def test_varlen_frame_list_pad_clip_parity(self, tmp_path):
+    path = str(tmp_path / 'clips.tfrecord')
+    features = SpecStruct(
+        clips=TensorSpec((3, 32, 48, 3), np.uint8, name='clips',
+                         data_format='jpeg', varlen_default_value=0.0))
+    rng = np.random.RandomState(1)
+    records = []
+    for n_frames in (2, 3, 5):  # short (pad), exact, long (clip)
+      jpegs = [numpy_to_image_string(
+          rng.randint(0, 255, (32, 48, 3), dtype=np.uint8))
+          for _ in range(n_frames)]
+      records.append(build_example({'clips': jpegs}))
+    tfrecord.write_records(path, records)
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    assert plan is not None
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=3,
+                                               num_epochs=1)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    ref, _ = ExampleParser(features, SpecStruct()).parse_batch(records)
+    np.testing.assert_array_equal(np.asarray(feats['clips']),
+                                  np.asarray(ref['clips']))
+    assert np.asarray(feats['clips']).shape == (3, 3, 32, 48, 3)
+
+  def _optional_records(self, present):
+    rng = np.random.RandomState(2)
+    records = []
+    for has_opt in present:
+      example = {'vec': rng.rand(3).astype(np.float32)}
+      if has_opt:
+        example['opt'] = rng.rand(2).astype(np.float32)
+      records.append(build_example(example))
+    return records
+
+  def _optional_specs(self):
+    return SpecStruct(
+        vec=TensorSpec((3,), np.float32, name='vec'),
+        opt=TensorSpec((2,), np.float32, name='opt', is_optional=True))
+
+  def test_optional_fully_present_batch_keeps_key(self, tmp_path):
+    path = str(tmp_path / 'opt_full.tfrecord')
+    records = self._optional_records([True, True, True, True])
+    tfrecord.write_records(path, records)
+    features = self._optional_specs()
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=4,
+                                               num_epochs=1)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    ref, _ = ExampleParser(features, SpecStruct()).parse_batch(records)
+    assert 'opt' in ref and 'opt' in feats
+    np.testing.assert_array_equal(np.asarray(feats['opt']),
+                                  np.asarray(ref['opt']))
+
+  def test_optional_partial_batch_drops_key(self, tmp_path):
+    path = str(tmp_path / 'opt_part.tfrecord')
+    records = self._optional_records([True, False, True, True])
+    tfrecord.write_records(path, records)
+    features = self._optional_specs()
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    stream = native_loader.NativeBatchedStream(plan, [path], batch_size=4,
+                                               num_epochs=1)
+    try:
+      (feats, _), = list(stream)
+    finally:
+      stream.close()
+    ref, _ = ExampleParser(features, SpecStruct()).parse_batch(records)
+    assert 'opt' not in ref  # the oracle's dense-batch semantics
+    assert 'opt' not in feats
+    np.testing.assert_array_equal(np.asarray(feats['vec']),
+                                  np.asarray(ref['vec']))
+
+  def test_multi_dataset_zip_parity(self, tmp_path):
+    from tensor2robot_tpu.data.pipeline import (
+        BatchedExampleStream,
+        RecordDataset,
+    )
+
+    main_path = str(tmp_path / 'main.tfrecord')
+    aux_path = str(tmp_path / 'aux.tfrecord')
+    rng = np.random.RandomState(3)
+    main_records = [build_example({
+        'img/encoded': numpy_to_image_string(
+            rng.randint(0, 255, (16, 16, 3), dtype=np.uint8)),
+        'vec': rng.rand(3).astype(np.float32)}) for _ in range(6)]
+    # The aux dataset is LONGER: zip must end with the shortest.
+    aux_records = [build_example({'aux_v': rng.rand(2).astype(np.float32)})
+                   for _ in range(9)]
+    tfrecord.write_records(main_path, main_records)
+    tfrecord.write_records(aux_path, aux_records)
+    features = SpecStruct(
+        image=TensorSpec((16, 16, 3), np.uint8, name='img/encoded',
+                         data_format='jpeg'),
+        vec=TensorSpec((3,), np.float32, name='vec'),
+        aux_v=TensorSpec((2,), np.float32, name='aux_v',
+                         dataset_key='aux'))
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    assert plan is not None and plan.dataset_keys == ['', 'aux']
+    stream = native_loader.NativeBatchedStream(
+        plan, {'': [main_path], 'aux': [aux_path]}, batch_size=2,
+        num_epochs=1)
+    try:
+      native_batches = list(stream)
+    finally:
+      stream.close()
+    py_batches = list(iter(BatchedExampleStream(
+        {'': RecordDataset(main_path),
+         'aux': RecordDataset(aux_path, dataset_key='aux')},
+        ExampleParser(features, SpecStruct()),
+        batch_size=2, shuffle=False, num_epochs=1)))
+    assert len(native_batches) == len(py_batches) == 3
+    for (nf, _), (pf, _) in zip(native_batches, py_batches):
+      for key in pf:
+        np.testing.assert_array_equal(np.asarray(nf[key]),
+                                      np.asarray(pf[key]), err_msg=key)
+
+  def test_empty_file_list_rejected_at_create(self):
+    # An empty group would spin the zip reader on nothing; it must fail
+    # at CREATE (a config error), like the pre-zip 'files 0' contract.
+    features = SpecStruct(x=TensorSpec((2,), np.float32, name='x'))
+    plan = native_loader.plan_for_specs(features, SpecStruct())
+    with pytest.raises(RuntimeError, match='empty file group'):
+      native_loader.NativeBatchedStream(plan, [], batch_size=2)
+
+  def test_zip_generator_takes_native_path(self, tmp_path):
+    """dataset_map datasets route through the native loader now
+    (use_native=True raised 'only supported by the Python pipeline'
+    before round 6)."""
+    rng = np.random.RandomState(4)
+    main_path = str(tmp_path / 'm.tfrecord')
+    aux_path = str(tmp_path / 'a.tfrecord')
+    tfrecord.write_records(main_path, [
+        build_example({'vec': rng.rand(3).astype(np.float32)})
+        for _ in range(8)])
+    tfrecord.write_records(aux_path, [
+        build_example({'aux_v': rng.rand(2).astype(np.float32)})
+        for _ in range(8)])
+    features = SpecStruct(
+        vec=TensorSpec((3,), np.float32, name='vec'),
+        aux_v=TensorSpec((2,), np.float32, name='aux_v',
+                         dataset_key='aux'))
+    gen = DefaultRecordInputGenerator(
+        dataset_map={'': main_path, 'aux': aux_path}, batch_size=4,
+        use_native=True)
+    gen.set_specification(features, SpecStruct())
+    it = gen.create_dataset_iterator(mode=ModeKeys.EVAL, num_epochs=1)
+    feats, _ = next(it)
+    assert np.asarray(feats['vec']).shape == (4, 3)
+    assert np.asarray(feats['aux_v']).shape == (4, 2)
 
 
 def _sequence_specs():

@@ -27,7 +27,9 @@ from tensor2robot_tpu.rl import collect_eval as collect_eval_lib
 from tensor2robot_tpu.rl import run_env as run_env_fn  # package re-export
 from tensor2robot_tpu.rl.offpolicy import (
     BellmanQTOptTrainer,
+    concat_ranking_pairs,
     pairwise_ranking_accuracy,
+    ranking_accuracy_from_scores,
     split_offpolicy_batch,
     strip_offpolicy_features,
 )
@@ -243,6 +245,76 @@ def _make_q_base(model):
     return outputs['q_predicted']
 
   return q_base
+
+
+class TestRankingAccuracyBatchStats:
+  """The former docstring caveat, as an executable contract: a critic
+  normalized with BATCH statistics erases any feature that is constant
+  within a forward batch. Each ranking-pair arm holds a constant action
+  column, so a per-arm forward erases exactly the action signal being
+  measured; the helper must therefore evaluate both arms in ONE
+  concatenated forward — and does, by construction."""
+
+  def _pairs(self, n_pairs=6, rows=8):
+    rng = np.random.RandomState(0)
+    pairs = []
+    for _ in range(n_pairs):
+      state = rng.randn(rows, 3).astype(np.float32)
+      # Both arms share the state; only the (arm-constant) action differs.
+      pairs.append((
+          {'state': state, 'action': np.full((rows, 1), 1.0, np.float32)},
+          {'state': state, 'action': np.full((rows, 1), 0.0, np.float32)},
+      ))
+    return pairs
+
+  @staticmethod
+  def _batch_stat_critic(features):
+    """Q = batch-normalized action column: within one forward, a feature
+    constant across the batch contributes exactly zero."""
+    x = np.concatenate([features['state'], features['action']], axis=1)
+    x = x - x.mean(axis=0, keepdims=True)  # batch-statistics normalization
+    return x[:, -1]
+
+  def test_concatenated_forward_preserves_arm_constant_signal(self):
+    pairs = self._pairs()
+    assert pairwise_ranking_accuracy(self._batch_stat_critic, pairs) == 1.0
+
+  def test_per_arm_forward_would_erase_the_signal(self):
+    # The OLD (per-arm) evaluation, inlined: scoring each arm alone zeroes
+    # the arm-constant action column — accuracy collapses to 0 ranked
+    # correct. This is the failure mode the helper's one-forward contract
+    # exists to prevent.
+    pairs = self._pairs()
+    correct = total = 0
+    for better, worse in pairs:
+      qb = self._batch_stat_critic(better)
+      qw = self._batch_stat_critic(worse)
+      correct += int((qb > qw).sum())
+      total += qb.size
+    assert correct / total == 0.0
+
+  def test_helper_makes_one_call(self):
+    pairs = self._pairs()
+    calls = []
+
+    def critic(features):
+      calls.append(int(features['action'].shape[0]))
+      return self._batch_stat_critic(features)
+
+    pairwise_ranking_accuracy(critic, pairs)
+    total_rows = sum(arm['action'].shape[0] for p in pairs for arm in p)
+    assert calls == [total_rows]
+
+  def test_split_helpers_round_trip(self):
+    pairs = self._pairs(n_pairs=3, rows=4)
+    combined, arm_rows = concat_ranking_pairs(pairs)
+    assert arm_rows == [4] * 6
+    assert combined['state'].shape == (24, 3)
+    scores = np.arange(24, dtype=np.float32)  # every worse arm scores higher
+    assert ranking_accuracy_from_scores(scores, arm_rows) == 0.0
+    assert ranking_accuracy_from_scores(-scores, arm_rows) == 1.0
+    with pytest.raises(ValueError, match='one score per row'):
+      ranking_accuracy_from_scores(scores[:-1], arm_rows)
 
 
 class TestOffPolicyLearning:
