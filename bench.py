@@ -20,7 +20,10 @@ optimizer and EMA update. Extra fields:
   * e2e_samples_per_sec    — training from DISK in steady state: fresh
                              batches decoded by the native loader and fed
                              through host->device transfer while the
-                             device steps (min of the three stage rates).
+                             device steps; e2e_bottleneck names the
+                             binding stage via the SAME attribution rule
+                             the live pipeline X-ray uses
+                             (observability/pipeline_xray.py).
   * transfer_mb_per_sec    — measured host->device bandwidth; on this
                              environment's tunneled TPU it is ~15 MB/s
                              (vs ~32 GB/s PCIe on a real v5e host), which
@@ -61,7 +64,6 @@ impossible by construction, while a genuinely unstable measurement
 import json
 import os
 import tempfile
-import threading
 import time
 
 import numpy as np
@@ -374,7 +376,6 @@ def _bench_e2e_from_disk(model_factory, mesh, batch_size: int,
     features, labels = parsed
     return {'features': features.to_dict(), 'labels': labels.to_dict()}
 
-  thread = None
   with tempfile.TemporaryDirectory() as tmp:
     first_features, first_labels = next(native_it)
     bytes_per_example = sum(
@@ -383,63 +384,32 @@ def _bench_e2e_from_disk(model_factory, mesh, batch_size: int,
     trainer, state, step_fn, rng, _ = _trainer_step_setup(
         model, mesh, batch_size, tmp,
         sample_batch=(first_features, first_labels))
+    buffered = None
     try:
       # Background host thread: decode + device_put the NEXT batch while
-      # the device runs the current step (double buffering).
-      q = []
-      lock = threading.Condition()
-      stop = []
-      errors = []
+      # the device runs the current step — the reusable instrumented
+      # double buffer (data/device_feed.py DoubleBufferedFeed, which
+      # also publishes pipeline/transfer/buffer_occupancy).
+      from tensor2robot_tpu.data.device_feed import DoubleBufferedFeed
 
-      def _producer():
-        try:
-          while not stop:
-            device_batch = trainer._put_batch(_to_batch(next(native_it)))
-            with lock:
-              while len(q) >= 2 and not stop:
-                lock.wait(0.05)
-              if stop:
-                return
-              q.append(device_batch)
-              lock.notify_all()
-        except BaseException as e:  # surfaced on the consumer side
-          with lock:
-            errors.append(e)
-            lock.notify_all()
-
-      thread = threading.Thread(target=_producer, daemon=True)
-      thread.start()
-
-      def _next_device_batch():
-        with lock:
-          while not q:
-            if errors:
-              raise errors[0]
-            lock.wait(0.05)
-          batch = q.pop(0)
-          lock.notify_all()
-          return batch
-
-      batch = _next_device_batch()
+      buffered = DoubleBufferedFeed(
+          (_to_batch(parsed) for parsed in native_it),
+          trainer._put_batch, depth=2)
+      batch = buffered.get()
       state, _ = step_fn(state, batch['features'], batch['labels'], rng)
       _sync(state)
       t0 = time.time()
       for _ in range(n_steps):
-        batch = _next_device_batch()
+        batch = buffered.get()
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
       _sync(state)
       dt = time.time() - t0
-      stop.append(True)
-      with lock:
-        q.clear()
-        lock.notify_all()
+    finally:
+      trainer.close()
       # The producer may be blocked inside the native loader's next();
       # that returns within one batch-decode. Join BEFORE closing the
       # stream so the C++ loader is never destroyed under a live call.
-      thread.join(timeout=60)
-    finally:
-      trainer.close()
-      if thread is not None and thread.is_alive():
+      if buffered is not None and not buffered.close(timeout=60):
         # Producer wedged: leak the loader rather than destroy it under a
         # live call (stream.__del__ is also skipped via _closed).
         stream._closed = True
@@ -1491,17 +1461,26 @@ def main():
     dense_bytes = 512 * 640 * 3 + 64
     out['e2e_bytes_per_example'] = round(e2e_bytes, 1)
     out['e2e_transfer_compression'] = round(dense_bytes / e2e_bytes, 2)
-    # Name the binding stage from the measured stage rates. host_decode is
-    # the rate of the SAME coef_sparse plan the e2e run used (entropy-only
-    # decode + sparse pack), not the full-decode rate.
+    # Name the binding stage with the SAME attribution rule the live
+    # pipeline X-ray applies to its busy-time capacity estimates
+    # (observability/pipeline_xray.attribute_stages) — bench and live
+    # training report one quantity, under the X-ray's canonical stage
+    # names ('decode' is the rate of the SAME coef_sparse plan the e2e
+    # run used: entropy-only decode + sparse pack, not full decode).
+    from tensor2robot_tpu.observability.pipeline_xray import (
+        attribute_stages,
+    )
     stages = {'device': per_chip * n_chips,
-              'host_decode': out.get(
+              'decode': out.get(
                   'host_sparse_examples_per_sec',
                   out.get('host_examples_per_sec', -1))}
     if out.get('transfer_mb_per_sec', -1) > 0:
       stages['transfer'] = (out['transfer_mb_per_sec'] * 1e6 / e2e_bytes)
-    out['e2e_bottleneck'] = min(stages, key=lambda k: stages[k]
-                                if stages[k] > 0 else float('inf'))
+    attribution = attribute_stages(stages)
+    out['e2e_bottleneck'] = attribution['bottleneck']
+    if attribution['headroom_vs_device'] is not None:
+      out['e2e_headroom_vs_device'] = round(
+          attribution['headroom_vs_device'], 4)
   except Exception:  # noqa: BLE001
     out['e2e_samples_per_sec'] = -1.0
   finally:

@@ -1,24 +1,38 @@
-"""Sparse-aware host->device batch feed.
+"""Host->device batch feeds: the transfer hop, instrumented and sparse-aware.
 
-The last hop of the split-decode input path (SURVEY hard-part #3). A
-``DeviceDecodePreprocessor(sparse=True)`` pipeline ships images as sparse
-DCT entry streams (``key/{sd,sv,qt,n}``, data/native/record_loader.cc) whose
-second dim is BUCKETED per batch — the format's transfer savings come from
-slicing buffers to the batch's actual entry count. Unpacking them inside the
-jitted train step would therefore recompile the whole model per bucket;
-instead this feed converts sparse groups to the fixed-shape dense
-coefficient tensors (``key/{y,cb,cr}``) the preprocessor consumes, in a
-SEPARATE tiny jit cached per (batch, bucket) shape, right after the
-host->device transfer:
+Two jobs live here:
+
+1. **The transfer stage of the pipeline X-ray** (ISSUE 7,
+   observability/pipeline_xray.py). Every batch the trainer ships crosses
+   ``put_batch``, so this is the one place the host->device hop is
+   metered: ``pipeline/transfer/{examples,bytes,busy_seconds}`` counters,
+   a ``pipeline/transfer/ms`` per-batch histogram, and — via
+   :class:`DoubleBufferedFeed` — the ``pipeline/transfer/buffer_occupancy``
+   gauge. The reliability ``data.stall`` FaultInjector site also lives on
+   this hop: an armed stall is indistinguishable from a wedged transfer,
+   which is exactly the symptom the X-ray must attribute.
+
+2. **The sparse-coef unpack** (SURVEY hard-part #3). A
+   ``DeviceDecodePreprocessor(sparse=True)`` pipeline ships images as
+   sparse DCT entry streams (``key/{sd,sv,qt,n}``,
+   data/native/record_loader.cc) whose second dim is BUCKETED per batch —
+   the format's transfer savings come from slicing buffers to the batch's
+   actual entry count. Unpacking them inside the jitted train step would
+   recompile the whole model per bucket; instead
+   :class:`SparseCoefFeed` converts sparse groups to the fixed-shape
+   dense coefficient tensors (``key/{y,cb,cr}``) in a SEPARATE tiny jit
+   cached per (batch, bucket) shape, right after the host->device
+   transfer:
 
     host batch (sparse, ~8x fewer bytes) --transfer--> device
       --unpack jit (cumsum + scatter-add, ~15 ms / 64 frames)-->
     dense coef batch --train step (shape-stable, never recompiles)-->
 
-Non-sparse batches pass through as a plain ``shard_batch``, so the Trainer
-routes every batch through :meth:`SparseCoefFeed.put_batch` unconditionally.
+The Trainer routes EVERY batch through a feed's :meth:`put_batch`
+(:class:`HostDeviceFeed` when no sparse groups are in play), so the
+transfer stage is metered unconditionally.
 
-The shape-stability contract above is ASSERTED as telemetry, not just
+The shape-stability contract is ASSERTED as telemetry, not just
 documented: every emitted batch's shape signature lands in the
 ``data/feed_shape_signatures`` gauge (must stay 1 — the observability
 watchdog's ``recompile`` trigger fires otherwise) and the per-bucket
@@ -28,22 +42,113 @@ once per bucket, then plateau).
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Dict, Optional, Set, Tuple
 
 from tensor2robot_tpu.data import jpeg_device
 from tensor2robot_tpu.observability import get_registry
+from tensor2robot_tpu.observability.pipeline_xray import StageMeter
+from tensor2robot_tpu.observability.spans import SPAN_BUCKETS_MS
 from tensor2robot_tpu.parallel import sharding as sharding_lib
+from tensor2robot_tpu.reliability import fault_injection
 
 FEED_SHAPES_GAUGE = 'data/feed_shape_signatures'
 UNPACK_COMPILES_GAUGE = 'recompiles/coef_unpack'
+TRANSFER_MS_HISTOGRAM = 'pipeline/transfer/ms'
+BUFFER_OCCUPANCY_GAUGE = 'pipeline/transfer/buffer_occupancy'
 
 
-class SparseCoefFeed:
+def _batch_examples_and_bytes(batch: dict) -> Tuple[int, int]:
+  """(leading dim, total host bytes) of a {'features', 'labels'} batch."""
+  examples = 0
+  nbytes = 0
+  for side in ('features', 'labels'):
+    values = batch.get(side)
+    if not values:
+      continue
+    for value in values.values():
+      size = getattr(value, 'nbytes', 0)
+      nbytes += int(size or 0)
+      shape = getattr(value, 'shape', None)
+      if not examples and shape:
+        examples = int(shape[0])
+  return examples, nbytes
+
+
+class HostDeviceFeed:
+  """The plain host->device hop: shard_batch + transfer-stage telemetry."""
+
+  def __init__(self, mesh):
+    self._mesh = mesh
+    registry = get_registry()
+    self._transfer_meter = StageMeter('transfer', registry)
+    self._transfer_ms = registry.histogram(TRANSFER_MS_HISTOGRAM,
+                                           bounds=SPAN_BUCKETS_MS)
+
+  def put_batch(self, batch: dict, channel: str = 'train') -> dict:
+    """Ships one host batch to the device, metering the hop.
+
+    The hop is timed to COMPLETION (``block_until_ready``), not to
+    dispatch: ``device_put`` returns after enqueueing the copy, and on a
+    transfer-limited link (BENCH_r05: 24.6 MB/s tunneled) a
+    dispatch-only measurement would overestimate transfer capacity by
+    orders of magnitude and the X-ray could never attribute the stage
+    bench names. Blocking here costs no overlap: this host thread waits
+    while the device still runs the PREVIOUS step (and the production
+    e2e path calls this from :class:`DoubleBufferedFeed`'s producer
+    thread, where the wait is free by construction).
+
+    Only the ``'train'`` channel feeds the ``pipeline/transfer`` stage
+    counters — the X-ray's e2e flow meter counts train batches, so an
+    in-process eval's batches must not inflate the same window's
+    transfer capacity. Every channel still lands in the per-batch
+    ``pipeline/transfer/ms`` histogram.
+
+    The ``data.stall`` FaultInjector site fires here (the loader/feed
+    path's stall injection, docs/reliability.md): a stalled transfer is
+    the symptom the pipeline X-ray must catch as ``pipeline_stall`` and
+    attribute to this stage.
+    """
+    examples, nbytes = _batch_examples_and_bytes(batch)
+    t0 = time.perf_counter()
+    stall_s = fault_injection.stall_data_seconds()
+    if stall_s > 0.0:
+      time.sleep(stall_s)
+    device = self._transfer(batch)
+    elapsed = time.perf_counter() - t0
+    self._transfer_ms.record(elapsed * 1e3)
+    if channel == 'train':
+      self._transfer_meter.add(examples=examples, nbytes=nbytes,
+                               busy_s=elapsed)
+    return self._finish(device, channel)
+
+  def _transfer(self, batch: dict) -> dict:
+    """The timed hop: shard + copy, synchronized. Subclass work that is
+    NOT the wire (e.g. the sparse unpack jit, whose per-bucket
+    compilation costs seconds) belongs in ``_finish`` — inside this
+    window it would collapse the measured MB/s and fire a spurious
+    ``transfer_regression``."""
+    device = sharding_lib.shard_batch(batch, self._mesh)
+    try:
+      import jax
+
+      jax.block_until_ready(device)
+    except Exception:  # noqa: BLE001 — non-array leaves etc.: keep feeding
+      pass
+    return device
+
+  def _finish(self, device: dict, channel: str) -> dict:
+    """Post-transfer device-side work; identity for the plain feed."""
+    return device
+
+
+class SparseCoefFeed(HostDeviceFeed):
   """Converts host batches with sparse coef groups into device batches."""
 
   def __init__(self, image_shapes: Dict[str, Tuple[int, int]], mesh):
+    super().__init__(mesh)
     self._shapes = dict(image_shapes)
-    self._mesh = mesh
     self._jit_cache = {}
     self._signatures: Dict[str, Set[Tuple]] = {}
     registry = get_registry()
@@ -116,9 +221,9 @@ class SparseCoefFeed:
     self._shape_gauge.set(float(len(self._signatures.get('train', ()))))
     self._unpack_gauge.set(float(len(self._jit_cache)))
 
-  def put_batch(self, batch: dict, channel: str = 'train') -> dict:
-    """shard_batch + on-device sparse->dense coef unpack where present."""
-    device = sharding_lib.shard_batch(batch, self._mesh)
+  def _finish(self, device: dict, channel: str) -> dict:
+    """On-device sparse->dense coef unpack where present (untimed: the
+    unpack is device compute riding AFTER the metered wire hop)."""
     features = device.get('features')
     if not features or not any(
         key + '/sd' in features for key in self._shapes):
@@ -140,3 +245,90 @@ class SparseCoefFeed:
     device = dict(device)
     device['features'] = features
     return device
+
+
+class DoubleBufferedFeed:
+  """Background host->device producer: transfer overlaps device compute.
+
+  Wraps a host-batch iterator and a feed: a daemon thread decodes and
+  ships batch N+1..N+depth while the device runs step N — the double
+  buffering ``bench.py``'s e2e run used inline, now reusable and
+  instrumented. The ``pipeline/transfer/buffer_occupancy`` gauge holds
+  the buffered-batch fraction at the last hand-off: pinned near 0 means
+  the consumer (device) outruns the host path — the pipeline gates;
+  near 1 means the host comfortably leads.
+
+  Errors from the producer (including the wrapped iterator's
+  StopIteration) surface on the consumer side at ``get()``;
+  ``close()`` stops the thread without draining it.
+  """
+
+  def __init__(self, batch_iterator, feed,
+               depth: int = 2, channel: str = 'train'):
+    """``feed``: a :class:`HostDeviceFeed` (or anything with its
+    ``put_batch(batch, channel=...)``), or a bare callable with the same
+    signature (e.g. ``Trainer._put_batch``)."""
+    put_batch = feed.put_batch if hasattr(feed, 'put_batch') else feed
+    self._depth = max(1, int(depth))
+    self._buffer = []
+    self._lock = threading.Condition()
+    self._stopped = False
+    self._done = False
+    self._errors = []
+    self._occupancy = get_registry().gauge(BUFFER_OCCUPANCY_GAUGE)
+
+    def _producer():
+      try:
+        for batch in batch_iterator:
+          device_batch = put_batch(batch, channel=channel)
+          with self._lock:
+            while len(self._buffer) >= self._depth and not self._stopped:
+              self._lock.wait(0.05)
+            if self._stopped:
+              return
+            self._buffer.append(device_batch)
+            self._occupancy.set(len(self._buffer) / self._depth)
+            self._lock.notify_all()
+      except BaseException as e:  # surfaced on the consumer side
+        with self._lock:
+          self._errors.append(e)
+          self._lock.notify_all()
+      finally:
+        with self._lock:
+          self._done = True
+          self._lock.notify_all()
+
+    self._thread = threading.Thread(target=_producer, daemon=True,
+                                    name='t2r-device-feed')
+    self._thread.start()
+
+  def get(self):
+    """The next device batch; raises StopIteration at end of data."""
+    with self._lock:
+      while True:
+        if self._buffer:
+          batch = self._buffer.pop(0)
+          self._occupancy.set(len(self._buffer) / self._depth)
+          self._lock.notify_all()
+          return batch
+        if self._errors:
+          raise self._errors[0]
+        if self._done:
+          raise StopIteration
+        self._lock.wait(0.05)
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    return self.get()
+
+  def close(self, timeout: float = 60.0) -> bool:
+    """Stops the producer; returns whether its thread exited in time."""
+    with self._lock:
+      self._stopped = True
+      self._buffer.clear()
+      self._occupancy.set(0.0)
+      self._lock.notify_all()
+    self._thread.join(timeout=timeout)
+    return not self._thread.is_alive()

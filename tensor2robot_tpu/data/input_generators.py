@@ -49,6 +49,7 @@ def prefetch_iterator(iterator: Iterator, depth: int,
   import threading
 
   from tensor2robot_tpu.observability import get_registry
+  from tensor2robot_tpu.observability.pipeline_xray import StageMeter
 
   q: 'queue.Queue' = queue.Queue(maxsize=depth)
   sentinel = object()
@@ -63,6 +64,15 @@ def prefetch_iterator(iterator: Iterator, depth: int,
       'data/batches_prefetched', ('queue',)).series(label)
   queue_depth = registry.gauge_family(
       'data/prefetch_queue_depth', ('queue',)).series(label)
+  # Pipeline X-ray 'batch' stage: this producer is the ONE batch-handoff
+  # point every generator path (native, Python parser, synthetic) runs
+  # through, so it owns the stage's example count — the flow meter.
+  # No busy time is charged here: the handoff is a queue put whose only
+  # real cost is downstream backpressure (queue-full waits), which must
+  # NOT be attributed to this stage; the stage's health signals are the
+  # flow count and the prefetch-depth gauge, and it never competes in
+  # the capacity argmin (native pack cost is pipeline/batch/pack_ms).
+  batch_meter = StageMeter('batch')
 
   def _put(item) -> bool:
     while not stop.is_set():
@@ -74,10 +84,23 @@ def prefetch_iterator(iterator: Iterator, depth: int,
         continue
     return False
 
+  def _batch_examples(item) -> int:
+    """Leading dim of the first array leaf of a (features, labels) item."""
+    features = item[0] if isinstance(item, tuple) else item
+    try:
+      for key in features:
+        shape = getattr(features[key], 'shape', None)
+        if shape:
+          return int(shape[0])
+    except TypeError:
+      pass
+    return 0
+
   def _producer():
     try:
       for item in iterator:
         prefetched.inc()
+        batch_meter.add(examples=_batch_examples(item))
         if not _put(item):
           return
     except BaseException as e:  # surfaced on the consumer side

@@ -52,6 +52,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
@@ -714,6 +715,15 @@ std::string decode_jpeg_coef_sparse(const uint8_t* data, size_t n,
 // Loader.
 // ---------------------------------------------------------------------------
 
+// Monotonic microseconds for the pipeline-stats busy/idle accounting:
+// steady_clock, never wall time — the same discipline the Python side
+// enforces with tests/test_no_wallclock.py.
+inline long long now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 enum SlotState { kFree, kFilling, kReady, kInUse };
 
 struct Slot {
@@ -761,6 +771,57 @@ struct Loader {
   // eagerly-parsing workers and the constructor's last_error poll.
   std::once_flag launch_once;
 
+  // ---- pipeline stats (t2r_loader_stats export) ---------------------------
+  // Cumulative, relaxed atomics written from the reader/worker threads
+  // and read racily by the consumer — the Python X-ray layer windows the
+  // deltas, so torn cross-field reads only cost sub-window skew. Safe to
+  // read BEFORE the lazy thread launch (all zeros) and after EOF.
+  std::atomic<long long> st_records_read{0};   // records framed off disk
+  std::atomic<long long> st_bytes_read{0};     // incl. TFRecord framing
+  std::atomic<long long> st_reader_busy_us{0}; // read + shuffle time
+  std::atomic<long long> st_reader_wait_us{0}; // blocked on slots/space
+  std::atomic<long long> st_rows_parsed{0};    // batch rows completed
+  std::atomic<long long> st_parse_bytes{0};    // record bytes parsed
+  std::atomic<long long> st_worker_busy_us{0}; // parse/decode, pool total
+  std::atomic<long long> st_worker_idle_us{0}; // waiting for work, total
+  std::unique_ptr<std::atomic<long long>[]> st_per_worker_busy_us;
+
+  long long stats_snapshot(long long* out, int n) {
+    long long min_busy = 0, max_busy = 0;
+    if (st_per_worker_busy_us && cfg.threads > 0) {
+      min_busy = max_busy =
+          st_per_worker_busy_us[0].load(std::memory_order_relaxed);
+      for (int i = 1; i < cfg.threads; i++) {
+        long long v =
+            st_per_worker_busy_us[i].load(std::memory_order_relaxed);
+        if (v < min_busy) min_busy = v;
+        if (v > max_busy) max_busy = v;
+      }
+    }
+    long long completed;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      completed = completed_batches;
+    }
+    const long long vals[12] = {
+        st_records_read.load(std::memory_order_relaxed),
+        st_bytes_read.load(std::memory_order_relaxed),
+        st_reader_busy_us.load(std::memory_order_relaxed),
+        st_reader_wait_us.load(std::memory_order_relaxed),
+        st_rows_parsed.load(std::memory_order_relaxed),
+        st_parse_bytes.load(std::memory_order_relaxed),
+        st_worker_busy_us.load(std::memory_order_relaxed),
+        st_worker_idle_us.load(std::memory_order_relaxed),
+        (long long)cfg.threads,
+        completed,
+        min_busy,
+        max_busy,
+    };
+    int m = n < 12 ? n : 12;
+    for (int i = 0; i < m; i++) out[i] = vals[i];
+    return m;
+  }
+
   ~Loader() { shutdown(); }
 
   void shutdown() {
@@ -795,6 +856,7 @@ struct Loader {
   bool dispatch_row(std::vector<std::string>&& recs, int* cur_slot,
                     int* cur_row, long long* seq) {
     if (*cur_slot < 0) {  // acquire a free slot
+      long long t0 = now_us();
       std::unique_lock<std::mutex> lk(mu);
       cv_free.wait(lk, [&] {
         if (stop) return true;
@@ -802,6 +864,7 @@ struct Loader {
           if (s.state == kFree) return true;
         return false;
       });
+      st_reader_wait_us.fetch_add(now_us() - t0, std::memory_order_relaxed);
       if (stop) return false;
       for (size_t i = 0; i < slots.size(); i++) {
         if (slots[i].state == kFree) {
@@ -816,10 +879,12 @@ struct Loader {
       }
     }
     {
+      long long t0 = now_us();
       std::unique_lock<std::mutex> lk(mu);
       cv_space.wait(lk, [&] {
         return stop || work.size() < (size_t)(4 * cfg.threads + 64);
       });
+      st_reader_wait_us.fetch_add(now_us() - t0, std::memory_order_relaxed);
       if (stop) return false;
       work.push_back(WorkItem{std::move(recs), *cur_slot, *cur_row});
     }
@@ -947,6 +1012,9 @@ struct Loader {
             return -1;
           }
         }
+        loader->st_records_read.fetch_add(1, std::memory_order_relaxed);
+        loader->st_bytes_read.fetch_add(16 + (long long)len,
+                                        std::memory_order_relaxed);
         return 1;
       }
     }
@@ -968,6 +1036,7 @@ struct Loader {
     for (;;) {
       std::vector<std::string> tuple(n_groups);
       bool end_of_data = false;
+      long long t0 = now_us();
       for (size_t g = 0; g < n_groups; g++) {
         std::string err;
         int status = streams[g].next(&tuple[g], &err);
@@ -980,6 +1049,7 @@ struct Loader {
           break;
         }
       }
+      st_reader_busy_us.fetch_add(now_us() - t0, std::memory_order_relaxed);
       if (end_of_data) break;
       if (!dispatch_row(std::move(tuple), &cur_slot, &cur_row, &seq))
         return;
@@ -1412,19 +1482,32 @@ struct Loader {
     return "";
   }
 
-  void worker_main() {
+  void worker_main(int worker_index) {
     for (;;) {
       WorkItem item;
       {
+        long long t_idle = now_us();
         std::unique_lock<std::mutex> lk(mu);
         cv_work.wait(lk, [&] { return stop.load() || !work.empty(); });
+        st_worker_idle_us.fetch_add(now_us() - t_idle,
+                                    std::memory_order_relaxed);
         if (stop.load()) return;
         if (work.empty()) continue;
         item = std::move(work.front());
         work.pop_front();
       }
       cv_space.notify_one();
+      long long t_busy = now_us();
       std::string err = parse_into(item.records, item.slot, item.row);
+      long long busy = now_us() - t_busy;
+      st_worker_busy_us.fetch_add(busy, std::memory_order_relaxed);
+      st_per_worker_busy_us[worker_index].fetch_add(
+          busy, std::memory_order_relaxed);
+      st_rows_parsed.fetch_add(1, std::memory_order_relaxed);
+      long long record_bytes = 0;
+      for (const auto& rec : item.records)
+        record_bytes += (long long)rec.size();
+      st_parse_bytes.fetch_add(record_bytes, std::memory_order_relaxed);
       Slot& slot = slots[item.slot];
       if (!err.empty()) {
         // Record the error but DEFER the fail/swallow decision to batch
@@ -1474,7 +1557,7 @@ struct Loader {
       if (stop.load()) return;  // config already failed at create
       reader = std::thread([this] { reader_main(); });
       for (int i = 0; i < cfg.threads; i++)
-        threads.emplace_back([this] { worker_main(); });
+        threads.emplace_back([this, i] { worker_main(i); });
     });
   }
 
@@ -1514,6 +1597,9 @@ struct Loader {
   bool start(std::string* err) {
     // Buffers only — threads launch on the first next_slot() call
     // (ensure_launched), so create-time errors are config errors ONLY.
+    st_per_worker_busy_us.reset(
+        new std::atomic<long long>[cfg.threads > 0 ? cfg.threads : 1]);
+    for (int i = 0; i < cfg.threads; i++) st_per_worker_busy_us[i] = 0;
     slots.resize(cfg.ring);
     for (auto& s : slots) {
       for (long long sz : cfg.buffer_sizes) {
@@ -1572,6 +1658,16 @@ void* t2r_loader_buffer_ptr(void* h, int slot, int buf) {
 int t2r_loader_ring_size(void* h) { return (int)((Loader*)h)->slots.size(); }
 
 int t2r_loader_next(void* h) { return ((Loader*)h)->next_slot(); }
+
+// Pipeline X-ray stats: fills up to n slots of `out` with the cumulative
+// counters [records_read, bytes_read, reader_busy_us, reader_wait_us,
+// rows_parsed, parse_bytes, worker_busy_us, worker_idle_us, n_workers,
+// completed_batches, min_worker_busy_us, max_worker_busy_us]; returns the
+// count written. Never launches the worker threads (lazy-launch boundary
+// preserved): before the first next() every value is 0.
+long long t2r_loader_stats(void* h, long long* out, int n) {
+  return ((Loader*)h)->stats_snapshot(out, n);
+}
 
 void t2r_loader_release(void* h, int slot) { ((Loader*)h)->release(slot); }
 

@@ -104,8 +104,20 @@ def _lib():
     lib.t2r_loader_next.argtypes = [ctypes.c_void_p]
     lib.t2r_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.t2r_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.t2r_loader_stats.restype = ctypes.c_longlong
+    lib.t2r_loader_stats.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_longlong),
+                                     ctypes.c_int]
     _LIB = lib
   return _LIB
+
+
+# t2r_loader_stats slot order (record_loader.cc stats_snapshot).
+_STAT_NAMES = ('records_read', 'bytes_read', 'reader_busy_us',
+               'reader_wait_us', 'rows_parsed', 'parse_bytes',
+               'worker_busy_us', 'worker_idle_us', 'n_workers',
+               'completed_batches', 'min_worker_busy_us',
+               'max_worker_busy_us')
 
 
 class _Field:
@@ -439,6 +451,57 @@ class NativeBatchedStream:
     self._views = self._build_views()
     self._held_slot = -1
     self._closed = False
+    # Pipeline X-ray publishing (observability/pipeline_xray.py): the C++
+    # loader's cumulative stats become pipeline/{read,decode}/* counter
+    # DELTAS at every batch, so the registry stays monotonic even across
+    # several streams in one process (each stream publishes only what it
+    # added since its own last publish).
+    self._published_stats = {name: 0 for name in _STAT_NAMES}
+    self._stage_meters = None
+
+  def stats(self) -> Dict[str, int]:
+    """Cumulative loader-side stats (record_loader.cc stats_snapshot).
+
+    Zeros before the first ``next()`` — reading stats never launches the
+    reader/worker threads (the lazy-launch error-delivery contract).
+    After ``close()`` the last published values are gone; zeros again.
+    """
+    if not self._handle:
+      return {name: 0 for name in _STAT_NAMES}
+    buf = (ctypes.c_longlong * len(_STAT_NAMES))()
+    n = int(self._lib.t2r_loader_stats(self._handle, buf, len(_STAT_NAMES)))
+    return {name: int(buf[i]) for i, name in enumerate(_STAT_NAMES[:n])}
+
+  def _publish_stats(self) -> None:
+    from tensor2robot_tpu.observability import get_registry
+    from tensor2robot_tpu.observability.pipeline_xray import (
+        DECODE_IDLE_COUNTER,
+        DECODE_WORKERS_GAUGE,
+        StageMeter,
+    )
+
+    if self._stage_meters is None:
+      registry = get_registry()
+      self._stage_meters = (StageMeter('read', registry),
+                            StageMeter('decode', registry),
+                            registry.counter(DECODE_IDLE_COUNTER),
+                            registry.gauge(DECODE_WORKERS_GAUGE))
+    read_meter, decode_meter, idle_counter, workers_gauge = \
+        self._stage_meters
+    stats = self.stats()
+    delta = {name: stats[name] - self._published_stats.get(name, 0)
+             for name in stats}
+    self._published_stats = stats
+    read_meter.add(examples=delta.get('records_read', 0),
+                   nbytes=delta.get('bytes_read', 0),
+                   busy_s=delta.get('reader_busy_us', 0) / 1e6)
+    decode_meter.add(examples=delta.get('rows_parsed', 0),
+                     nbytes=delta.get('parse_bytes', 0),
+                     busy_s=delta.get('worker_busy_us', 0) / 1e6)
+    idle = delta.get('worker_idle_us', 0)
+    if idle > 0:
+      idle_counter.inc(idle / 1e6)
+    workers_gauge.set(float(stats.get('n_workers', 0)))
 
   # -- buffer views ----------------------------------------------------------
 
@@ -589,9 +652,17 @@ class NativeBatchedStream:
     return features, labels
 
   def __iter__(self):
+    import time
+
+    from tensor2robot_tpu.observability import get_registry
+    from tensor2robot_tpu.observability.spans import SPAN_BUCKETS_MS
+
+    pack_ms = get_registry().histogram('pipeline/batch/pack_ms',
+                                       bounds=SPAN_BUCKETS_MS)
     while True:
       slot = self._lib.t2r_loader_next(self._handle)
       if slot == -1:
+        self._publish_stats()
         self._release_held()
         return
       if slot < 0:
@@ -599,7 +670,13 @@ class NativeBatchedStream:
         raise RuntimeError('native loader: ' +
                            (err or b'?').decode('utf-8', 'replace'))
       try:
+        t_pack = time.perf_counter()
         batch = self._pack(slot)
+        # Busy-only histogram: the pack rows are already counted by the
+        # decode stage, so a batch-stage examples counter here would
+        # double-count them in the X-ray capacity table.
+        pack_ms.record((time.perf_counter() - t_pack) * 1e3)
+        self._publish_stats()
       finally:
         if self._copy:
           self._lib.t2r_loader_release(self._handle, slot)

@@ -188,17 +188,48 @@ class BatchedExampleStream:
       yield tup
 
   def _batches(self):
+    import time
+
+    from tensor2robot_tpu.observability.pipeline_xray import StageMeter
+
+    # Pipeline X-ray stages for the pure-Python path (the analog of the
+    # C++ loader's t2r_loader_stats export): 'read' is record I/O +
+    # interleave + shuffle, 'decode' is the spec-driven parse (which for
+    # this single-threaded parser includes batch assembly — np.stack
+    # inside parse_batch). Flushed once per batch, never per record.
+    read_meter = StageMeter('read')
+    decode_meter = StageMeter('decode')
     pending: List[Dict[str, bytes]] = []
-    for tup in self._record_tuples():
+    pending_bytes = 0
+    read_s = 0.0
+    tuples = self._record_tuples()
+    while True:
+      t0 = time.perf_counter()
+      tup = next(tuples, None)
+      read_s += time.perf_counter() - t0
+      if tup is None:
+        break
       pending.append(tup)
+      pending_bytes += sum(len(record) for record in tup.values())
       if len(pending) == self._batch_size:
-        with span('data.parse'):
+        read_meter.add(examples=len(pending), nbytes=pending_bytes,
+                       busy_s=read_s)
+        with span('data.parse') as sp:
           batch = self._parse(pending)
+        decode_meter.add(examples=len(pending), nbytes=pending_bytes,
+                         busy_s=sp.elapsed)
         yield batch
         pending = []
+        pending_bytes = 0
+        read_s = 0.0
     if pending and not self._drop_remainder:
-      with span('data.parse'):
-        yield self._parse(pending)
+      read_meter.add(examples=len(pending), nbytes=pending_bytes,
+                     busy_s=read_s)
+      with span('data.parse') as sp:
+        batch = self._parse(pending)
+      decode_meter.add(examples=len(pending), nbytes=pending_bytes,
+                       busy_s=sp.elapsed)
+      yield batch
 
   def _parse(self, tuples: List[Dict[str, bytes]]):
     by_key = {key: [t[key] for t in tuples] for key in tuples[0]}

@@ -36,6 +36,17 @@ answers:
   * `doctor.py` — ranked offline diagnosis from telemetry.jsonl +
     forensics reports (``bin/t2r_telemetry doctor``; jax-free).
 
+Pipeline X-ray (ISSUE 7) makes the host->device data path a measured,
+per-stage quantity instead of a bench-time inference:
+
+  * `pipeline_xray.py` — the stage model (read/decode/batch/transfer/
+    device), source-side ``StageMeter`` counters every data layer
+    reports into, the windowed ``PipelineXray`` bottleneck attribution
+    (``t2r.pipeline.v1`` records in telemetry.jsonl), the
+    ``attribute_stages`` rule bench.py shares, and the pipeline anomaly
+    kinds (``pipeline_stall`` / ``worker_starvation`` /
+    ``transfer_regression``) feeding the capture loop.
+
 Metric name catalog, forensics report schema, and goodput definitions:
 docs/observability.md.
 """
@@ -51,6 +62,13 @@ from tensor2robot_tpu.observability.forensics import (
 from tensor2robot_tpu.observability.goodput import (
     CATEGORIES as GOODPUT_CATEGORIES,
     GoodputTracker,
+)
+from tensor2robot_tpu.observability.pipeline_xray import (
+    PIPELINE_RECORD_SCHEMA,
+    PipelineXray,
+    StageMeter,
+    XrayConfig,
+    attribute_stages,
 )
 from tensor2robot_tpu.observability.signals import (
     install_jax_listeners,
@@ -99,12 +117,17 @@ __all__ = [
     'GoodputTracker',
     'HEARTBEAT_FILENAME',
     'Histogram',
+    'PIPELINE_RECORD_SCHEMA',
+    'PipelineXray',
+    'StageMeter',
     'TELEMETRY_FILENAME',
     'TelemetryLogger',
     'TelemetryRegistry',
     'Watchdog',
     'WatchdogConfig',
+    'XrayConfig',
     'attribute_goodput',
+    'attribute_stages',
     'build_report',
     'exponential_buckets',
     'get_registry',

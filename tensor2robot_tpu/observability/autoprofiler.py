@@ -82,6 +82,7 @@ class AutoProfiler:
     self._stop_step = 0
     self._start_walltime: Optional[float] = None
     self._start_snapshot: Optional[Dict[str, Dict[str, object]]] = None
+    self._start_pipeline: Optional[Dict[str, object]] = None
     self._captures_taken = 0
     self._last_capture_end: Optional[float] = None
     self.last_report_path: Optional[str] = None
@@ -198,6 +199,17 @@ class AutoProfiler:
       self._start_snapshot = self.registry.snapshot()
     except Exception:  # noqa: BLE001
       self._start_snapshot = None
+    # The pipeline X-ray record is INCIDENT evidence: snapshot it as the
+    # window opens (one iteration after the anomaly fired, before the
+    # next log-cadence observe). By window close the newest record
+    # describes the capture's own overhead window — profiler start/stop
+    # is seconds on some backends — not the stall it answers.
+    self._start_pipeline = None
+    if self.context_fn is not None:
+      try:
+        self._start_pipeline = (self.context_fn() or {}).get('pipeline')
+      except Exception as e:  # noqa: BLE001
+        _log('Forensics context callback at window open failed: %s', e)
     self.registry.counter_family(CAPTURE_COUNTER, ('trigger',)) \
         .series(reason).inc()
     # Spans now also emit TraceAnnotations, so the host-side seams
@@ -263,7 +275,8 @@ class AutoProfiler:
         goodput_fractions=context.get('goodput'),
         counters_delta=counters_delta,
         registry=self.registry,
-        tuned_config=context.get('tuned_config'))
+        tuned_config=context.get('tuned_config'),
+        pipeline=self._start_pipeline)
     path = forensics.write_report(self.model_dir, step, report)
     self.last_report_path = path
     _log('Forensics report: %s (top op: %s)', path,

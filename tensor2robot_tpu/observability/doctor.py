@@ -177,6 +177,50 @@ def diagnose(model_dir: str,
           '({:.1f} -> {:.1f} MiB): leak signature'.format(
               tag, len(values), values[0] / 2**20, values[-1] / 2**20)))
 
+  # Pipeline X-ray: the latest t2r.pipeline.v1 attribution + stalls.
+  pipelines = [r for r in records if r.get('kind') == 'pipeline']
+  if pipelines:
+    latest = pipelines[-1]
+    bottleneck = latest.get('bottleneck')
+    headroom = latest.get('headroom_vs_device')
+    if bottleneck and bottleneck != 'device' and headroom is not None \
+        and headroom < 0.5:
+      findings.append(_finding(
+          WARNING, 'pipeline gated by {} at {:.0%} of the device rate '
+          '(step {}): the input path, not the chip, caps e2e '
+          'throughput'.format(bottleneck, headroom, latest.get('step')),
+          bottleneck=bottleneck, headroom_vs_device=headroom))
+    elif bottleneck:
+      findings.append(_finding(
+          INFO, 'pipeline@{}: gating stage {} (headroom vs device '
+          '{})'.format(latest.get('step'), bottleneck,
+                       'n/a' if headroom is None
+                       else '{:.0%}'.format(headroom))))
+  stall_indices = [i for i, r in enumerate(records)
+                   if r.get('kind') == 'anomaly'
+                   and r.get('anomaly') == 'pipeline_stall']
+  if stall_indices:
+    last_index = stall_indices[-1]
+    last_stall = records[last_index]
+    stage = (last_stall.get('detail') or {}).get('stage', 'unknown')
+    # Recovery check: a LATER pipeline record not itself flagging a
+    # stall means flow resumed — one historical hiccup must not hold
+    # the automation gate at exit 2 for the rest of a days-long run.
+    # (The same window's train/pipeline records are co-emitted with the
+    # anomaly, so only a subsequent HEALTHY window counts.)
+    recovered = any(
+        r.get('kind') == 'pipeline'
+        and 'pipeline_stall' not in (r.get('anomalies') or [])
+        for r in records[last_index + 1:])
+    findings.append(_finding(
+        # A CURRENTLY stalled pipeline halts training: CRITICAL while
+        # the run is live and unrecovered; historical context otherwise.
+        WARNING if (run_ended or recovered) else CRITICAL,
+        'pipeline stalled {} time(s), last at step {}{} (gating stage: '
+        '{})'.format(len(stall_indices), last_stall.get('step'),
+                     ' — recovered since' if recovered else '', stage),
+        stage=stage, count=len(stall_indices), recovered=recovered))
+
   # Watchdog anomaly records written in-process.
   anomalies = [r for r in records if r.get('kind') == 'anomaly']
   if anomalies:

@@ -214,14 +214,18 @@ def build_report(step: int,
                  goodput_fractions: Optional[Dict[str, float]] = None,
                  counters_delta: Optional[Dict[str, float]] = None,
                  registry: Optional[registry_lib.TelemetryRegistry] = None,
-                 tuned_config: Optional[str] = None
+                 tuned_config: Optional[str] = None,
+                 pipeline: Optional[Dict[str, object]] = None
                  ) -> Dict[str, object]:
   """Assembles the forensics report dict. Never raises: torn captures,
   missing HLO, or reader bugs each degrade to a ``warnings`` entry.
 
   ``tuned_config``: the active compile-config id (tuning/), or None for
   the stock compile — carried verbatim so a step-time regression is
-  attributable to the config that compiled the step it profiled."""
+  attributable to the config that compiled the step it profiled.
+  ``pipeline``: the latest ``t2r.pipeline.v1`` X-ray record (stage
+  capacity table + gating-stage attribution), carried verbatim so a
+  data-path incident's report names the stage, not just the symptom."""
   registry = registry or registry_lib.get_registry()
   warnings: List[str] = []
   report: Dict[str, object] = {
@@ -241,6 +245,7 @@ def build_report(step: int,
       'counters_delta': dict(counters_delta or {}),
       'memory': {},
       'tuned_config': tuned_config,
+      'pipeline': dict(pipeline) if pipeline else None,
       'warnings': warnings,
   }
   try:

@@ -7,14 +7,27 @@ TensorBoard events are for humans with a browser; fleet tooling (and
   * ``telemetry.jsonl`` — one JSON object per line:
     ``{"time": <unix>, "kind": "...", "step": <int|null>, ...payload}``.
     Kinds written by the trainer: ``run_start``, ``train`` (scalars +
-    goodput at the log cadence), ``preempted``, ``rollback``,
-    ``run_abort`` (any other exception escaping the loop), ``run_end``.
-    The file is append-only across restarts — a preempted run's history
-    survives its own resumption.
+    goodput at the log cadence), ``pipeline`` (the X-ray's
+    ``t2r.pipeline.v1`` attribution record), ``anomaly``, ``forensics``,
+    ``preempted``, ``rollback``, ``run_abort`` (any other exception
+    escaping the loop), ``run_end``. The file is append-only across
+    restarts — a preempted run's history survives its own resumption.
   * ``heartbeat.json`` — atomically replaced (tmp + rename) at the log
     cadence: ``{"time", "step", "pid", "hostname"}``. A watchdog that
     sees a stale heartbeat knows the process is wedged even when the
     jsonl tail looks healthy; readers never observe a half-written file.
+
+**Rotation**: the live file is capped (``max_bytes``, default 256 MiB —
+weeks-long runs with per-log-cadence ``pipeline`` records would
+otherwise grow it unboundedly). At the cap the writer renames the live
+file to ``telemetry.jsonl.1`` (shifting ``.1`` -> ``.2`` ... up to
+``max_rotated`` generations, oldest dropped) and starts a fresh live
+file — always at a LINE boundary, so rotated files never hold torn
+interior records. The live file keeps its name, which is what lets
+``t2r_telemetry tail --follow`` ride through a rotation (it sees the
+size shrink and restarts from the new top). ``read_telemetry``
+stitches rotated generations back in oldest-first, so doctor/summarize
+keep the full retained history.
 
 ``read_telemetry`` tolerates a torn final line (the writer may be killed
 mid-append) but raises on malformed interior lines — silent corruption
@@ -30,25 +43,60 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ['TelemetryLogger', 'read_telemetry', 'read_heartbeat',
-           'TELEMETRY_FILENAME', 'HEARTBEAT_FILENAME']
+           'rotated_paths', 'TELEMETRY_FILENAME', 'HEARTBEAT_FILENAME',
+           'DEFAULT_MAX_BYTES', 'DEFAULT_MAX_ROTATED']
 
 TELEMETRY_FILENAME = 'telemetry.jsonl'
 HEARTBEAT_FILENAME = 'heartbeat.json'
 
+DEFAULT_MAX_BYTES = 256 * 2**20
+DEFAULT_MAX_ROTATED = 2
+
 
 class TelemetryLogger:
-  """Appends telemetry records and maintains the heartbeat for one run."""
+  """Appends telemetry records and maintains the heartbeat for one run.
 
-  def __init__(self, model_dir: str):
+  ``max_bytes`` caps the LIVE file; crossing it rotates (see module
+  docstring). ``max_bytes=None`` disables rotation (the pre-cap
+  behavior). ``max_rotated`` bounds retained generations, so total disk
+  is ~``max_bytes * (1 + max_rotated)``.
+  """
+
+  def __init__(self, model_dir: str,
+               max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
+               max_rotated: int = DEFAULT_MAX_ROTATED):
     os.makedirs(model_dir, exist_ok=True)
     self.model_dir = model_dir
+    self.max_bytes = None if max_bytes is None else int(max_bytes)
+    self.max_rotated = max(1, int(max_rotated))
     self._path = os.path.join(model_dir, TELEMETRY_FILENAME)
     self._heartbeat_path = os.path.join(model_dir, HEARTBEAT_FILENAME)
     self._file = open(self._path, 'a', encoding='utf-8')
+    # Tracked size, NOT self._file.tell(): tell() on a text append
+    # stream flushes the write buffer, which would turn every log()
+    # into a disk write and quietly change the buffered-append /
+    # explicit-flush() (torn-tail) semantics.
+    self._size = os.path.getsize(self._path)
 
   @property
   def path(self) -> str:
     return self._path
+
+  def _maybe_rotate(self, incoming_bytes: int) -> None:
+    if self.max_bytes is None:
+      return
+    if self._size == 0 or self._size + incoming_bytes <= self.max_bytes:
+      return  # a fresh file always takes at least one record
+    self._file.flush()
+    self._file.close()
+    # Shift .1 -> .2 -> ... (newest rotated is .1); the oldest falls off.
+    for index in range(self.max_rotated, 1, -1):
+      older = '{}.{}'.format(self._path, index - 1)
+      if os.path.exists(older):
+        os.replace(older, '{}.{}'.format(self._path, index))
+    os.replace(self._path, self._path + '.1')
+    self._file = open(self._path, 'a', encoding='utf-8')
+    self._size = 0
 
   def log(self, kind: str, step: Optional[int] = None,
           **payload) -> Dict[str, object]:
@@ -58,7 +106,11 @@ class TelemetryLogger:
         'kind': kind,
         'step': None if step is None else int(step)}
     record.update(payload)
-    self._file.write(json.dumps(record) + '\n')
+    line = json.dumps(record) + '\n'
+    encoded = len(line.encode('utf-8'))
+    self._maybe_rotate(encoded)
+    self._file.write(line)
+    self._size += encoded
     return record
 
   def heartbeat(self, step: Optional[int] = None, **extra) -> None:
@@ -84,14 +136,23 @@ class TelemetryLogger:
       self._file.close()
 
 
-def read_telemetry(path: str) -> List[Dict[str, object]]:
-  """Parses a telemetry.jsonl file (or the model_dir holding one).
+def rotated_paths(path: str) -> List[str]:
+  """Existing generations of one telemetry file, OLDEST first.
 
-  A torn FINAL line (writer killed mid-append) is dropped silently;
-  malformed interior lines raise ValueError naming the line number.
+  ``path`` is the live file; the result ends with it:
+  ``[telemetry.jsonl.2, telemetry.jsonl.1, telemetry.jsonl]``.
   """
-  if os.path.isdir(path):
-    path = os.path.join(path, TELEMETRY_FILENAME)
+  out: List[str] = []
+  index = 1
+  while os.path.exists('{}.{}'.format(path, index)):
+    out.append('{}.{}'.format(path, index))
+    index += 1
+  out.reverse()
+  out.append(path)
+  return out
+
+
+def _read_one(path: str) -> List[Dict[str, object]]:
   records: List[Dict[str, object]] = []
   with open(path, encoding='utf-8') as f:
     lines = f.read().splitlines()
@@ -105,6 +166,27 @@ def read_telemetry(path: str) -> List[Dict[str, object]]:
         break  # torn tail from a killed writer: ignore
       raise ValueError('{}:{} holds malformed telemetry: {}'.format(
           path, index + 1, e)) from e
+  return records
+
+
+def read_telemetry(path: str) -> List[Dict[str, object]]:
+  """Parses a telemetry.jsonl file (or the model_dir holding one),
+  including any rotated generations (oldest first).
+
+  A torn FINAL line (writer killed mid-append) is dropped silently —
+  per generation, since a pre-rotation run may have died mid-append
+  too; malformed interior lines raise ValueError naming the line
+  number.
+  """
+  if os.path.isdir(path):
+    path = os.path.join(path, TELEMETRY_FILENAME)
+  generations = [p for p in rotated_paths(path) if os.path.exists(p)]
+  if not generations:
+    # Preserve the no-telemetry contract callers already handle.
+    raise FileNotFoundError(path)
+  records: List[Dict[str, object]] = []
+  for generation in generations:
+    records.extend(_read_one(generation))
   return records
 
 

@@ -28,6 +28,12 @@ as monitored failures, not graphs someone may eyeball later. Detections
     the trainer loop IS the heartbeat writer, so staleness is checked by
     ``t2r_telemetry doctor`` / external monitors, not ``observe()``.
 
+Three further kinds — ``pipeline_stall``, ``worker_starvation``, and
+``transfer_regression`` — are detected by the pipeline X-ray
+(observability/pipeline_xray.py) over the ``pipeline/<stage>/...``
+counters and flow through the same ``watchdog/anomalies`` counter
+family, telemetry ``anomaly`` records, and capture-request loop.
+
 The watchdog holds no threads and does no I/O: ``observe()`` is a pure
 in-memory pass the trainer calls at its log cadence, and every duration
 it consumes comes from ``time.perf_counter`` windows upstream — the

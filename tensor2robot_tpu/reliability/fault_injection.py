@@ -14,6 +14,10 @@ Sites wired in this codebase (docs/reliability.md):
   * ``step.slow``     trainer loop → host-side sleep inflating the step
     time (``SLOW_STEP_SECONDS``), the symptom the observability
     watchdog must catch (docs/observability.md)
+  * ``data.stall``    host→device feed (data/device_feed.py put_batch) →
+    sleep stalling the data path (``DATA_STALL_SECONDS``), the symptom
+    the pipeline X-ray must catch as ``pipeline_stall`` and attribute
+    to the transfer stage (docs/observability.md "Pipeline X-ray")
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -31,14 +35,18 @@ SITE_CKPT_RESTORE = 'ckpt.restore'
 SITE_DATA_READ = 'data.read'
 SITE_STEP_NAN = 'step.nan'
 SITE_STEP_SLOW = 'step.slow'
+SITE_DATA_STALL = 'data.stall'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
-               SITE_STEP_NAN, SITE_STEP_SLOW)
+               SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL)
 
 # How long one fired 'step.slow' stalls the loop. Module-level (not per
 # armament) so tests tune it with a monkeypatch, matching the fixed
 # deterministic character of the injector.
 SLOW_STEP_SECONDS = 0.25
+
+# How long one fired 'data.stall' wedges the host->device feed.
+DATA_STALL_SECONDS = 0.25
 
 
 class FaultInjector:
@@ -129,6 +137,14 @@ def slow_step_seconds() -> float:
   injector = _INJECTOR
   if injector is not None and injector.fires(SITE_STEP_SLOW):
     return SLOW_STEP_SECONDS
+  return 0.0
+
+
+def stall_data_seconds() -> float:
+  """Seconds the 'data.stall' site wedges THIS batch; 0.0 when unarmed."""
+  injector = _INJECTOR
+  if injector is not None and injector.fires(SITE_DATA_STALL):
+    return DATA_STALL_SECONDS
   return 0.0
 
 

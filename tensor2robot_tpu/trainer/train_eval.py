@@ -43,6 +43,7 @@ from tensor2robot_tpu.observability import (
     span,
 )
 from tensor2robot_tpu.observability import goodput as goodput_lib
+from tensor2robot_tpu.observability import pipeline_xray as xray_lib
 from tensor2robot_tpu.observability import signals as signals_lib
 from tensor2robot_tpu.observability import watchdog as watchdog_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -119,6 +120,8 @@ class Trainer:
                profile_min_interval_secs: float = 600.0,
                enable_watchdog: bool = True,
                watchdog_config: Optional[WatchdogConfig] = None,
+               enable_pipeline_xray: bool = True,
+               xray_config: Optional[xray_lib.XrayConfig] = None,
                nan_policy: str = 'skip',
                nan_rollback_budget: int = 3,
                nan_check_every_n_steps: int = 1,
@@ -140,6 +143,12 @@ class Trainer:
     detection (step-time regression, goodput drop, recompiles, HBM
     growth) at the log cadence; detections are counted, written to
     telemetry.jsonl, and — with auto_profile — answered with a capture.
+    enable_pipeline_xray / xray_config: per-stage host->device dataflow
+    attribution at the log cadence (docs/observability.md "Pipeline
+    X-ray"): each window emits a ``t2r.pipeline.v1`` telemetry record
+    naming the gating stage and its headroom vs. the device rate, and
+    the pipeline anomaly kinds (pipeline_stall / worker_starvation /
+    transfer_regression) feed the same capture loop as the watchdog's.
     nan_policy: what the non-finite-loss sentinel does
     (docs/reliability.md): 'skip' (default) discards the poisoned update
     on device — params/opt state keep their pre-step values, only the
@@ -209,6 +218,8 @@ class Trainer:
         min_interval_secs=profile_min_interval_secs)
     self._watchdog = (Watchdog(watchdog_config) if enable_watchdog
                       else None)
+    self._xray = (xray_lib.PipelineXray(xray_config)
+                  if enable_pipeline_xray else None)
     # Compile-event accounting (jax/compiles, jax/compile_ms) feeds the
     # watchdog's recompile detection; idempotent per process.
     signals_lib.install_jax_listeners()
@@ -242,12 +253,17 @@ class Trainer:
     not trip the train-step invariant.
     """
     if not self._device_feed_built:
-      from tensor2robot_tpu.data.device_feed import SparseCoefFeed
-      self._device_feed = SparseCoefFeed.from_preprocessor(
+      from tensor2robot_tpu.data.device_feed import (
+          HostDeviceFeed,
+          SparseCoefFeed,
+      )
+      # EVERY batch crosses a feed (plain HostDeviceFeed when no sparse
+      # groups are in play) so the pipeline X-ray's transfer stage is
+      # metered unconditionally.
+      self._device_feed = (SparseCoefFeed.from_preprocessor(
           self.model.preprocessor, self.mesh)
+          or HostDeviceFeed(self.mesh))
       self._device_feed_built = True
-    if self._device_feed is None:
-      return sharding_lib.shard_batch(batch, self.mesh)
     return self._device_feed.put_batch(batch, channel=channel)
 
   @property
@@ -662,7 +678,9 @@ class Trainer:
     # stats come from relowering the step we just compiled.
     self._auto_profiler.context_fn = \
         lambda: {'goodput': tracker.fractions(),
-                 'tuned_config': self.active_config_id}
+                 'tuned_config': self.active_config_id,
+                 'pipeline': (self._xray.last_record
+                              if self._xray is not None else None)}
     self._auto_profiler.hlo_text_fn = self._train_step_hlo
     telemetry = self.telemetry_logger
     if telemetry is not None:
@@ -756,6 +774,25 @@ class Trainer:
               # this very TensorBoard write and telemetry record.
               signals_lib.sample_memory(registry)
               self._sample_recompiles(registry)
+              pipeline_record = None
+              if self._xray is not None:
+                # X-ray before watchdog: a data-path incident should
+                # claim the capture under its pipeline kind (with the
+                # stage attribution in the trigger), not as the generic
+                # step_time_regression the same stall also causes.
+                pipeline_record, pipeline_anomalies = self._xray.observe(
+                    step_i, examples=batch_size * steps_since_log,
+                    window_seconds=dt,
+                    goodput_seconds=tracker.seconds())
+                for anomaly in pipeline_anomalies:
+                  _log('Pipeline X-ray anomaly: %s', anomaly.message)
+                  if telemetry is not None:
+                    telemetry.log('anomaly', step=step_i,
+                                  anomaly=anomaly.kind,
+                                  message=anomaly.message,
+                                  detail=anomaly.detail)
+                  self._auto_profiler.request_capture(
+                      anomaly.kind, step_i, anomaly.detail)
               if self._watchdog is not None:
                 for anomaly in self._watchdog.observe(
                     step_i, step_time_s, tracker.seconds()):
@@ -794,6 +831,10 @@ class Trainer:
                               goodput_seconds=tracker.seconds(),
                               counters=snapshot['counters'],
                               gauges=snapshot['gauges'])
+                if pipeline_record is not None:
+                  # The t2r.pipeline.v1 attribution record: gating stage
+                  # + headroom vs. the device rate, per log window.
+                  telemetry.log('pipeline', step=step_i, **pipeline_record)
                 telemetry.heartbeat(step_i)
                 telemetry.flush()
               t_last = time.perf_counter()

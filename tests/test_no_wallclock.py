@@ -20,7 +20,11 @@ the offending file:line list.
 
 import os
 
-SCANNED_PACKAGES = ('trainer', 'reliability', 'observability')
+# 'data' joined the scan with the pipeline X-ray instrumentation (ISSUE
+# 7): the stage busy/idle accounting in pipeline.py / input_generators.py
+# / device_feed.py / native_loader.py is all durations, which must come
+# from time.perf_counter (the C++ twin uses std::chrono::steady_clock).
+SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
