@@ -86,6 +86,7 @@ from tensor2robot_tpu.observability.registry import (
     DEFAULT_SECONDS_BUCKETS,
     Gauge,
     Histogram,
+    SLO_LATENCY_BUCKETS_MS,
     TelemetryRegistry,
     exponential_buckets,
     get_registry,
@@ -119,6 +120,7 @@ __all__ = [
     'Histogram',
     'PIPELINE_RECORD_SCHEMA',
     'PipelineXray',
+    'SLO_LATENCY_BUCKETS_MS',
     'StageMeter',
     'TELEMETRY_FILENAME',
     'TelemetryLogger',

@@ -105,8 +105,10 @@ def diagnose(model_dir: str,
           WARNING, 'telemetry.jsonl is corrupt mid-file: {}'.format(e)))
 
   beat = telemetry_file.read_heartbeat(model_dir)
+  # 'serving_stop' counts as an orderly end: a PolicyServer that closed
+  # cleanly stops heartbeating by design, which is not a wedged process.
   run_ended = bool(records) and records[-1].get('kind') in (
-      'run_end', 'run_abort', 'preempted')
+      'run_end', 'run_abort', 'preempted', 'serving_stop')
   if run_ended and beat is not None:
     findings.append(_finding(
         INFO, 'run finished ({}); heartbeat age not meaningful'.format(
@@ -220,6 +222,56 @@ def diagnose(model_dir: str,
         '{})'.format(len(stall_indices), last_stall.get('step'),
                      ' — recovered since' if recovered else '', stage),
         stage=stage, count=len(stall_indices), recovered=recovered))
+
+  # Serving section (ISSUE 8): kind='serving' SLO reports from a
+  # PolicyServer. A p99 over the SLO in the newest evidence, while the
+  # server is still live, is the one condition a serving fleet pages on.
+  serving_indices = [i for i, r in enumerate(records)
+                     if r.get('kind') == 'serving']
+  if serving_indices:
+    latest = records[serving_indices[-1]]
+    breach_indices = [i for i in serving_indices
+                      if records[i].get('over_slo')
+                      and (records[i].get('requests') or 0) > 0]
+    if breach_indices:
+      last_breach = records[breach_indices[-1]]
+      # Recovery check (same shape as pipeline_stall): a LATER serving
+      # window that handled traffic back under the SLO means the breach
+      # passed — history, not a live page. A 'serving_stop' after the
+      # breach means nobody is being served out-of-SLO right now either.
+      recovered = any(
+          records[i].get('kind') == 'serving'
+          and not records[i].get('over_slo')
+          and (records[i].get('requests') or 0) > 0
+          for i in range(breach_indices[-1] + 1, len(records)))
+      stopped = any(r.get('kind') == 'serving_stop'
+                    for r in records[breach_indices[-1] + 1:])
+      findings.append(_finding(
+          WARNING if (run_ended or recovered or stopped) else CRITICAL,
+          'serving p99 {:.1f} ms exceeded the {:g} ms SLO in {} '
+          'window(s), last at {:.1f} req/s{}'.format(
+              last_breach.get('p99_ms', 0.0),
+              last_breach.get('slo_ms', 0.0), len(breach_indices),
+              last_breach.get('requests_per_sec', 0.0),
+              ' — recovered since' if recovered
+              else (' — server stopped' if stopped else ' (live)')),
+          p99_ms=last_breach.get('p99_ms'),
+          slo_ms=last_breach.get('slo_ms'),
+          count=len(breach_indices), recovered=recovered))
+    rejected = latest.get('rejected_total') or 0
+    if rejected > 0:
+      findings.append(_finding(
+          WARNING, 'admission control shed {:g} request(s) (queue depth '
+          'reached max): demand exceeds this replica\'s '
+          'capacity'.format(rejected), rejected_total=rejected))
+    if not breach_indices:
+      findings.append(_finding(
+          INFO, 'serving healthy: {:.1f} req/s, p99 {:.1f} ms vs SLO '
+          '{:g} ms, batch fill {:.0%}, params v{}'.format(
+              latest.get('requests_per_sec', 0.0),
+              latest.get('p99_ms', 0.0), latest.get('slo_ms', 0.0),
+              latest.get('batch_fill', 0.0),
+              latest.get('params_version', 0))))
 
   # Watchdog anomaly records written in-process.
   anomalies = [r for r in records if r.get('kind') == 'anomaly']

@@ -49,6 +49,7 @@ __all__ = [
     'snapshot_delta',
     'DEFAULT_LATENCY_BUCKETS_MS',
     'DEFAULT_SECONDS_BUCKETS',
+    'SLO_LATENCY_BUCKETS_MS',
 ]
 
 
@@ -67,6 +68,16 @@ def exponential_buckets(start: float, factor: float, count: int
 DEFAULT_LATENCY_BUCKETS_MS = exponential_buckets(0.05, 2.0, 21)
 # 1ms .. ~1000s in x2 steps: span durations (data waits, checkpoint saves).
 DEFAULT_SECONDS_BUCKETS = exponential_buckets(0.001, 2.0, 20)
+# SLO-resolution latency edges (ISSUE 8 satellite): the default x2 edges
+# put the 30 Hz envelope between 26.2 and 52.4 ms — a 26 ms-wide bucket,
+# which makes "p99 < 33 ms" unanswerable from the histogram. These edges
+# keep sub-ms resolution at the bottom (0.05..0.8 ms, x2) and 1 ms
+# resolution across 1..100 ms, so any percentile inside the SLO band is
+# interpolated to within 1 ms; >100 ms lands in x2 overflow decades up
+# to ~1.6 s (a wedged batch is still measured, just coarsely).
+SLO_LATENCY_BUCKETS_MS = (exponential_buckets(0.05, 2.0, 5)
+                          + tuple(float(i) for i in range(1, 101))
+                          + exponential_buckets(200.0, 2.0, 4))
 
 
 class Counter:
@@ -247,33 +258,67 @@ class Histogram:
 
 
 class _Family:
-  """A named instrument family keyed by label values."""
+  """A named instrument family keyed by label values.
 
-  def __init__(self, make, label_names: Tuple[str, ...]):
+  Histogram families additionally support **per-series bucket edges**
+  (ISSUE 8 satellite): ``series(*labels, bounds=...)`` creates that one
+  series with its own edges while every other series keeps the family
+  default — the serving latency series needs 1 ms SLO resolution, but
+  re-bucketing every existing predictor series for it would invalidate
+  their history. A later ``series()`` call without ``bounds`` returns
+  the existing instrument whatever its edges; a later call with
+  DIFFERENT explicit bounds raises (same torn-layout rationale as
+  ``TelemetryRegistry`` re-registration).
+  """
+
+  def __init__(self, make, label_names: Tuple[str, ...],
+               supports_bounds: bool = False):
     self._make = make
     self._label_names = label_names
+    self._supports_bounds = supports_bounds
     self._lock = threading.Lock()
     self._series: Dict[Tuple[str, ...], object] = {}
+    # key -> explicit per-series bounds (None = family default).
+    self._series_bounds: Dict[Tuple[str, ...], Optional[Tuple[float, ...]]] \
+        = {}
 
   @property
   def label_names(self) -> Tuple[str, ...]:
     return self._label_names
 
-  def series(self, *label_values: str):
+  def series(self, *label_values: str,
+             bounds: Optional[Sequence[float]] = None):
     """The child instrument for one label combination (cached).
 
     Resolve once outside hot loops; the instrument handle itself is then
-    allocation-free to write.
+    allocation-free to write. ``bounds`` (histogram families only)
+    overrides the family's bucket edges for THIS series at creation.
     """
     if len(label_values) != len(self._label_names):
       raise ValueError('Expected {} label value(s) {}; got {}.'.format(
           len(self._label_names), self._label_names, label_values))
+    explicit = tuple(float(b) for b in bounds) if bounds is not None \
+        else None
+    if explicit is not None and not self._supports_bounds:
+      raise ValueError('Per-series bounds are only supported on histogram '
+                       'families.')
     key = tuple(str(v) for v in label_values)
     with self._lock:
       child = self._series.get(key)
       if child is None:
-        child = self._make()
+        child = self._make(explicit) if self._supports_bounds \
+            else self._make()
         self._series[key] = child
+        if self._supports_bounds:
+          # Record the RESOLVED edges, so re-requesting with explicit
+          # bounds equal to the family default is consistent, not an
+          # error.
+          self._series_bounds[key] = tuple(child._bounds)  # noqa: SLF001
+      elif explicit is not None and \
+          self._series_bounds.get(key) != explicit:
+        raise ValueError(
+            'Series {!r} already created with bounds={!r}; requested '
+            '{!r}.'.format(key, self._series_bounds.get(key), explicit))
       return child
 
   def items(self) -> List[Tuple[Tuple[str, ...], object]]:
@@ -357,12 +402,18 @@ class TelemetryRegistry:
 
   def histogram_family(self, name: str, label_names: Sequence[str],
                        bounds: Optional[Sequence[float]] = None) -> _Family:
+    """``bounds`` is the family DEFAULT; individual series may override
+    it at creation via ``family.series(..., bounds=...)`` (per-series
+    SLO-resolution edges without re-bucketing sibling series)."""
     labels = tuple(label_names)
     explicit = tuple(bounds) if bounds is not None else None
     resolved = explicit if explicit is not None else DEFAULT_SECONDS_BUCKETS
     return self._get_or_create(
         name, 'histogram_family',
-        lambda: _Family(lambda: Histogram(resolved), labels),
+        lambda: _Family(
+            lambda series_bounds: Histogram(
+                series_bounds if series_bounds is not None else resolved),
+            labels, supports_bounds=True),
         requested={'labels': labels, 'bounds': explicit},
         config={'labels': labels, 'bounds': resolved})
 

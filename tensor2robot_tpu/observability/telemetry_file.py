@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -60,6 +61,12 @@ class TelemetryLogger:
   docstring). ``max_bytes=None`` disables rotation (the pre-cap
   behavior). ``max_rotated`` bounds retained generations, so total disk
   is ~``max_bytes * (1 + max_rotated)``.
+
+  Thread-safe within one process: ``log``/``heartbeat``/``flush`` take
+  an internal lock, so a PolicyServer's serve loop and its hot-swap
+  poller (ISSUE 8 — the first multi-threaded writer) cannot interleave
+  a record mid-line or race the rotation's close/reopen. Cross-PROCESS
+  writers still need separate files (each process tracks its own size).
   """
 
   def __init__(self, model_dir: str,
@@ -69,6 +76,7 @@ class TelemetryLogger:
     self.model_dir = model_dir
     self.max_bytes = None if max_bytes is None else int(max_bytes)
     self.max_rotated = max(1, int(max_rotated))
+    self._lock = threading.Lock()
     self._path = os.path.join(model_dir, TELEMETRY_FILENAME)
     self._heartbeat_path = os.path.join(model_dir, HEARTBEAT_FILENAME)
     self._file = open(self._path, 'a', encoding='utf-8')
@@ -108,9 +116,10 @@ class TelemetryLogger:
     record.update(payload)
     line = json.dumps(record) + '\n'
     encoded = len(line.encode('utf-8'))
-    self._maybe_rotate(encoded)
-    self._file.write(line)
-    self._size += encoded
+    with self._lock:
+      self._maybe_rotate(encoded)
+      self._file.write(line)
+      self._size += encoded
     return record
 
   def heartbeat(self, step: Optional[int] = None, **extra) -> None:
@@ -123,17 +132,21 @@ class TelemetryLogger:
     }
     beat.update(extra)
     tmp = self._heartbeat_path + '.tmp'
-    with open(tmp, 'w', encoding='utf-8') as f:
-      json.dump(beat, f)
-    os.replace(tmp, self._heartbeat_path)
+    with self._lock:  # two threads sharing one tmp path must serialize
+      with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(beat, f)
+      os.replace(tmp, self._heartbeat_path)
 
   def flush(self) -> None:
-    self._file.flush()
+    with self._lock:
+      if not self._file.closed:
+        self._file.flush()
 
   def close(self) -> None:
-    if not self._file.closed:
-      self._file.flush()
-      self._file.close()
+    with self._lock:
+      if not self._file.closed:
+        self._file.flush()
+        self._file.close()
 
 
 def rotated_paths(path: str) -> List[str]:

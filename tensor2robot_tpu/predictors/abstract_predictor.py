@@ -126,6 +126,19 @@ class AbstractPredictor(abc.ABC):
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
     """Runs the model on a dict of feature arrays (ref :40)."""
 
+  def predict_versioned(self, features: Dict[str, np.ndarray]):
+    """``(outputs, model_version)`` where BOTH come from one atomic read
+    of the loaded state — the versioned-params contract the serving
+    layer's hot-swap relies on (ISSUE 8): a concurrent ``restore`` must
+    never yield outputs from one version labeled with another.
+
+    The base implementation is only version-consistent when the subclass
+    keeps its loaded state in a single atomically-swapped snapshot;
+    CheckpointPredictor and ExportedModelPredictor do (and a regression
+    test hammers them, tests/test_predictors.py).
+    """
+    return self.predict(features), self.model_version
+
   @abc.abstractmethod
   def get_feature_specification(self):
     """The input features required for prediction (ref :51)."""

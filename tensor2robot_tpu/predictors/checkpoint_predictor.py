@@ -11,7 +11,7 @@ variables pytree — no graph rebuild, no session.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -33,6 +33,20 @@ _WAIT_REPORT_INTERVAL_SECS = 10.0
 CHECKPOINT_WAIT_GAUGE = 'inference/checkpoint_wait_seconds'
 
 
+class _Loaded(NamedTuple):
+  """One restored model version, swapped in as a single reference.
+
+  The versioned-params contract (ISSUE 8): every loaded field a serving
+  call needs lives in ONE immutable snapshot assigned atomically, so a
+  concurrent ``restore`` can never interleave — a predict that started
+  on step N finishes entirely on step N, and ``predict_versioned``
+  labels its outputs with the step that actually produced them.
+  """
+
+  variables: Any
+  step: int
+
+
 class CheckpointPredictor(AbstractPredictor):
   """Polls <checkpoint_dir>/checkpoints and serves the newest step."""
 
@@ -50,8 +64,7 @@ class CheckpointPredictor(AbstractPredictor):
     self._model = t2r_model
     self._checkpoint_dir = checkpoint_dir
     self._timeout = timeout
-    self._variables = None
-    self._restored_step: Optional[int] = None
+    self._loaded: Optional[_Loaded] = None
     # The one shared serving path (preprocess + predict_step), jitted once.
     self._serve_fn = jax.jit(make_serve_fn(t2r_model))
 
@@ -62,9 +75,10 @@ class CheckpointPredictor(AbstractPredictor):
     feature_spec = self._model.get_feature_specification_for_packing(
         ModeKeys.PREDICT)
     features = spec_generators.make_random_numpy(feature_spec, batch_size=1)
-    self._variables = self._model.init_variables(
-        jax.random.PRNGKey(0), features, None, ModeKeys.PREDICT)
-    self._restored_step = 0
+    self._loaded = _Loaded(
+        variables=self._model.init_variables(
+            jax.random.PRNGKey(0), features, None, ModeKeys.PREDICT),
+        step=0)
 
   def restore(self) -> bool:
     """Busy-waits for a (new) checkpoint, then loads it (ref :134-179).
@@ -89,12 +103,13 @@ class CheckpointPredictor(AbstractPredictor):
     try:
       while True:
         steps = checkpointing.all_checkpoint_steps(self._checkpoint_dir)
-        floor = self._restored_step if self._restored_step is not None else -1
+        loaded = self._loaded
+        floor = loaded.step if loaded is not None else -1
         # Newest first, but never DOWNGRADE below what is already loaded: a
         # permanently damaged newest step must not block serving when older
         # intact checkpoints sit in the same directory.
         candidates = [s for s in steps if s > floor]
-        if not candidates and self._restored_step is not None and steps:
+        if not candidates and loaded is not None and steps:
           return True  # nothing newer; current state is still valid
         for step in candidates:
           try:
@@ -137,22 +152,39 @@ class CheckpointPredictor(AbstractPredictor):
                  **(restored.get('model_state') or {})}
     if restored.get('avg_params') is not None:
       variables['avg_params'] = restored['avg_params']
-    self._variables = variables
-    self._restored_step = step
+    # One atomic reference swap: concurrent predict calls see either the
+    # whole old version or the whole new one, never a mix.
+    self._loaded = _Loaded(variables=variables, step=step)
     return True
 
   # -- serving ---------------------------------------------------------------
 
+  def _loaded_snapshot(self) -> _Loaded:
+    loaded = self._loaded  # ONE read; restore() swaps the whole reference
+    if loaded is None:
+      raise ValueError('The predictor has not been restored yet.')
+    return loaded
+
   @property
   def variables(self):
     """The restored variables pytree (for custom jitted serving paths)."""
-    self.assert_is_loaded()
-    return self._variables
+    return self._loaded_snapshot().variables
+
+  @property
+  def versioned_variables(self):
+    """``(version, variables)`` from one atomic snapshot read — what a
+    serving hot-swap consumes (PolicyServer.swap_from_predictor)."""
+    loaded = self._loaded_snapshot()
+    return loaded.step, loaded.variables
+
+  def predict_versioned(self, features: Dict[str, np.ndarray]):
+    loaded = self._loaded_snapshot()
+    outputs = self._serve_fn(loaded.variables, dict(features))
+    return ({k: np.asarray(v) for k, v in jax.device_get(outputs).items()},
+            loaded.step)
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    self.assert_is_loaded()
-    outputs = self._serve_fn(self._variables, dict(features))
-    return {k: np.asarray(v) for k, v in jax.device_get(outputs).items()}
+    return self.predict_versioned(features)[0]
 
   def get_feature_specification(self):
     return self._model.preprocessor.get_in_feature_specification(
@@ -163,19 +195,20 @@ class CheckpointPredictor(AbstractPredictor):
 
   @property
   def is_loaded(self) -> bool:
-    return self._variables is not None
+    return self._loaded is not None
 
   @property
   def global_step(self) -> int:
-    return self._restored_step or 0
+    loaded = self._loaded
+    return loaded.step if loaded is not None else 0
 
   @property
   def model_version(self) -> int:
-    return self._restored_step or 0
+    return self.global_step
 
   @property
   def model_path(self) -> str:
     return self._checkpoint_dir or ''
 
   def close(self) -> None:
-    self._variables = None
+    self._loaded = None

@@ -39,6 +39,39 @@ _WAIT_REPORT_INTERVAL_SECS = 10.0
 EXPORT_WAIT_GAUGE = 'inference/export_wait_seconds'
 
 
+class _Loaded:
+  """One loaded export version, swapped in as a single reference.
+
+  The pre-PR-8 implementation assigned serve_fn / variables / specs /
+  version as SEPARATE attributes; a predict racing a hot-swap could pair
+  the new serve function with the old variables (or parse request bytes
+  with the old spec and feed the new weights) — a mixed-version result.
+  Everything a serving call touches now rides one immutable snapshot
+  (versioned-params contract, ISSUE 8; regression test in
+  tests/test_predictors.py).
+  """
+
+  __slots__ = ('variables', 'exported_fn', 'serve_fn', 'raw_receivers',
+               'feature_spec', 'label_spec', 'version', 'global_step',
+               'model_path', 'parser')
+
+  def __init__(self, variables, exported_fn, serve_fn, raw_receivers,
+               feature_spec, label_spec, version, global_step, model_path):
+    self.variables = variables
+    self.exported_fn = exported_fn
+    self.serve_fn = serve_fn
+    self.raw_receivers = raw_receivers
+    self.feature_spec = feature_spec
+    self.label_spec = label_spec
+    self.version = version
+    self.global_step = global_step
+    self.model_path = model_path
+    # Derived lazily from THIS snapshot's spec on first
+    # predict_serialized; racing builders construct equal parsers, so
+    # last-write-wins is benign.
+    self.parser = None
+
+
 class ExportedModelPredictor(AbstractPredictor):
   """Serves the newest artifact under an export root directory."""
 
@@ -56,16 +89,7 @@ class ExportedModelPredictor(AbstractPredictor):
     self._export_dir = export_dir
     self._model = t2r_model
     self._timeout = timeout
-    self._feature_spec = None
-    self._label_spec = None
-    self._variables = None
-    self._exported_fn = None
-    self._serve_fn = None
-    self._parser = None
-    self._version: Optional[int] = None
-    self._global_step = 0
-    self._model_path = ''
-    self._raw_receivers = False
+    self._loaded: Optional[_Loaded] = None
 
   # -- restore ---------------------------------------------------------------
 
@@ -90,26 +114,29 @@ class ExportedModelPredictor(AbstractPredictor):
       return False  # racing GC/partial write: caller falls back
     raw = bool(export_generators.load_serving_config(version_dir)
                .get('raw_receivers', False))
-    if self._model is not None and (self._serve_fn is None or
-                                    raw != self._raw_receivers):
-      # Honor the artifact's receiver mode: raw artifacts must NOT be
-      # preprocessed again (ref abstract_export_generator.py:52).
-      self._serve_fn = jax.jit(
-          export_generators.make_serve_fn(self._model, raw_receivers=raw))
-    self._raw_receivers = raw
-    self._feature_spec = feature_spec
-    self._label_spec = label_spec
-    self._variables = variables
-    self._exported_fn = exported_fn
-    self._version = version
+    previous = self._loaded
+    serve_fn = None
+    if self._model is not None:
+      if previous is not None and previous.serve_fn is not None \
+          and raw == previous.raw_receivers:
+        serve_fn = previous.serve_fn  # same receiver mode: keep the jit
+      else:
+        # Honor the artifact's receiver mode: raw artifacts must NOT be
+        # preprocessed again (ref abstract_export_generator.py:52).
+        serve_fn = jax.jit(
+            export_generators.make_serve_fn(self._model, raw_receivers=raw))
     if step is None:
       try:
         step = assets_lib.load_global_step_from_file(version_dir)
       except (OSError, ValueError):
         step = 0
-    self._global_step = int(step or 0)
-    self._model_path = version_dir
-    self._parser = None  # re-derive from the new specs on demand
+    # The snapshot is fully built BEFORE the one reference assignment: a
+    # concurrent predict sees either all of the old version or all of
+    # the new one.
+    self._loaded = _Loaded(
+        variables=variables, exported_fn=exported_fn, serve_fn=serve_fn,
+        raw_receivers=raw, feature_spec=feature_spec, label_spec=label_spec,
+        version=version, global_step=int(step or 0), model_path=version_dir)
     return True
 
   def restore(self) -> bool:
@@ -126,14 +153,15 @@ class ExportedModelPredictor(AbstractPredictor):
     try:
       while True:
         versions = export_generators.list_exported_versions(self._export_dir)
+        loaded = self._loaded
         fresh = [v for v in versions
-                 if self._version is None or v > self._version]
+                 if loaded is None or v > loaded.version]
         # Newest first; a vanished/partial dir falls back to the next one
         # (ref :160-198 retry semantics).
         for version in reversed(fresh):
           if self._try_load_version(version):
             return True
-        if self._version is not None and versions:
+        if loaded is not None and versions:
           return True  # current version still newest and valid
         now = time.monotonic()
         if now >= next_report:
@@ -152,63 +180,85 @@ class ExportedModelPredictor(AbstractPredictor):
 
   # -- serving ---------------------------------------------------------------
 
+  def _loaded_snapshot(self) -> _Loaded:
+    loaded = self._loaded  # ONE read; restore() swaps the whole reference
+    if loaded is None:
+      raise ValueError('The predictor has not been restored yet.')
+    return loaded
+
   @property
   def variables(self):
     """The restored variables pytree (for custom jitted serving paths,
     e.g. DeviceCEMPolicy's one-dispatch CEM — checkpoint_predictor parity)."""
-    self.assert_is_loaded()
-    return self._variables
+    return self._loaded_snapshot().variables
+
+  @property
+  def versioned_variables(self):
+    """``(version, variables)`` from one atomic snapshot read — what a
+    serving hot-swap consumes (PolicyServer.swap_from_predictor)."""
+    loaded = self._loaded_snapshot()
+    return loaded.version, loaded.variables
+
+  @staticmethod
+  def _predict_from(loaded: _Loaded, features: Dict[str, np.ndarray]
+                    ) -> Dict[str, np.ndarray]:
+    if loaded.serve_fn is not None:
+      outputs = loaded.serve_fn(loaded.variables, dict(features))
+    else:
+      outputs = loaded.exported_fn.call(loaded.variables, dict(features))
+    return {k: np.asarray(v) for k, v in jax.device_get(outputs).items()}
+
+  def predict_versioned(self, features: Dict[str, np.ndarray]):
+    loaded = self._loaded_snapshot()
+    return self._predict_from(loaded, features), loaded.version
 
   def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    self.assert_is_loaded()
-    if self._serve_fn is not None:
-      outputs = self._serve_fn(self._variables, dict(features))
-    else:
-      outputs = self._exported_fn.call(self._variables, dict(features))
-    return {k: np.asarray(v) for k, v in jax.device_get(outputs).items()}
+    return self.predict_versioned(features)[0]
 
   def predict_serialized(self, records) -> Dict[str, np.ndarray]:
     """tf.Example receiver: record bytes -> parse by spec -> predict.
 
     ref default_export_generator.py:104-138 (the tf_example receiver).
+    The parser, spec, and weights all come from ONE snapshot — request
+    bytes can never be parsed with one version's spec and scored with
+    another's weights.
     """
-    self.assert_is_loaded()
-    if self._parser is None:
+    loaded = self._loaded_snapshot()
+    if loaded.parser is None:
       from tensor2robot_tpu.data.parser import ExampleParser  # lazy: serving
-      self._parser = ExampleParser(self._feature_spec, SpecStruct())
+      loaded.parser = ExampleParser(loaded.feature_spec, SpecStruct())
     if isinstance(records, bytes):
       records = [records]
-    features, _ = self._parser.parse_batch(records)
-    return self.predict(features.to_dict())
+    features, _ = loaded.parser.parse_batch(records)
+    return self._predict_from(loaded, features.to_dict())
 
   def get_feature_specification(self):
-    self.assert_is_loaded()
-    return self._feature_spec
+    return self._loaded_snapshot().feature_spec
 
   def get_label_specification(self):
-    self.assert_is_loaded()
-    return self._label_spec
+    return self._loaded_snapshot().label_spec
 
   @property
   def is_loaded(self) -> bool:
-    return self._variables is not None
+    return self._loaded is not None
 
   @property
   def model_version(self) -> int:
-    return self._version or 0
+    loaded = self._loaded
+    return loaded.version if loaded is not None else 0
 
   @property
   def global_step(self) -> int:
-    return self._global_step
+    loaded = self._loaded
+    return loaded.global_step if loaded is not None else 0
 
   @property
   def model_path(self) -> str:
-    return self._model_path
+    loaded = self._loaded
+    return loaded.model_path if loaded is not None else ''
 
   def close(self) -> None:
-    self._variables = None
-    self._exported_fn = None
-    # Reset version tracking: a closed predictor must not short-circuit a
-    # later restore() into "current version still newest and valid" while
-    # holding no loaded state.
-    self._version = None
+    # Dropping the snapshot also resets version tracking: a closed
+    # predictor must not short-circuit a later restore() into "current
+    # version still newest and valid" while holding no loaded state.
+    self._loaded = None

@@ -33,14 +33,38 @@ from tensor2robot_tpu.predictors.exported_model_predictor import (
 from tensor2robot_tpu.specs import assets as assets_lib
 
 
+class _LoadedSavedModel:
+  """One loaded SavedModel version, swapped in as a single reference.
+
+  Same versioned-snapshot contract as the parent's ``_Loaded`` (ISSUE
+  8): the module (which keeps the signatures' resources alive), both
+  signatures, specs, and version metadata ride ONE atomically-assigned
+  object, so a predict racing a hot-swap can never pair one version's
+  signature with another's spec or step. Attribute names match what the
+  parent's metadata properties read.
+  """
+
+  __slots__ = ('module', 'signature', 'tf_example_signature',
+               'feature_spec', 'label_spec', 'version', 'global_step',
+               'model_path')
+
+  def __init__(self, module, signature, tf_example_signature, feature_spec,
+               label_spec, version, global_step, model_path):
+    self.module = module
+    self.signature = signature
+    self.tf_example_signature = tf_example_signature
+    self.feature_spec = feature_spec
+    self.label_spec = label_spec
+    self.version = version
+    self.global_step = global_step
+    self.model_path = model_path
+
+
 class ExportedSavedModelPredictor(ExportedModelPredictor):
   """Serves the newest SavedModel version under an export root."""
 
   def __init__(self, export_dir: str, timeout: float = 600.0):
     super().__init__(export_dir, t2r_model=None, timeout=timeout)
-    self._loaded_module = None       # keeps signature resources alive
-    self._signature = None
-    self._tf_example_signature = None
 
   # -- restore ---------------------------------------------------------------
 
@@ -59,44 +83,45 @@ class ExportedSavedModelPredictor(ExportedModelPredictor):
       return False  # racing GC/partial write: caller falls back
     if 'serving_default' not in loaded.signatures:
       return False
-    self._loaded_module = loaded
-    self._signature = loaded.signatures['serving_default']
-    self._tf_example_signature = loaded.signatures.get('tf_example')
-    self._feature_spec = feature_spec
-    self._label_spec = label_spec
-    self._version = version
     if step is None:
       try:
         step = assets_lib.load_global_step_from_file(version_dir)
       except (OSError, ValueError):
         step = 0
-    self._global_step = int(step or 0)
-    self._model_path = version_dir
+    self._loaded = _LoadedSavedModel(
+        module=loaded, signature=loaded.signatures['serving_default'],
+        tf_example_signature=loaded.signatures.get('tf_example'),
+        feature_spec=feature_spec, label_spec=label_spec, version=version,
+        global_step=int(step or 0), model_path=version_dir)
     return True
 
   # -- serving ---------------------------------------------------------------
 
-  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+  def predict_versioned(self, features: Dict[str, np.ndarray]):
     import tensorflow as tf
 
-    self.assert_is_loaded()
-    outputs = self._signature(
+    loaded = self._loaded_snapshot()
+    outputs = loaded.signature(
         **{key: tf.constant(np.asarray(value))
            for key, value in features.items()})
-    return {key: np.asarray(value) for key, value in outputs.items()}
+    return ({key: np.asarray(value) for key, value in outputs.items()},
+            loaded.version)
+
+  def predict(self, features: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    return self.predict_versioned(features)[0]
 
   def predict_serialized(self, records) -> Dict[str, np.ndarray]:
     """tf.Example receiver via the SavedModel's IN-graph parser."""
     import tensorflow as tf
 
-    self.assert_is_loaded()
-    if self._tf_example_signature is None:
+    loaded = self._loaded_snapshot()
+    if loaded.tf_example_signature is None:
       raise ValueError(
           'SavedModel at {} exports no tf_example signature.'.format(
-              self._model_path))
+              loaded.model_path))
     if isinstance(records, bytes):
       records = [records]
-    outputs = self._tf_example_signature(tf.constant(list(records)))
+    outputs = loaded.tf_example_signature(tf.constant(list(records)))
     return {key: np.asarray(value) for key, value in outputs.items()}
 
   @property
@@ -107,11 +132,5 @@ class ExportedSavedModelPredictor(ExportedModelPredictor):
         'variable-level access).')
 
   @property
-  def is_loaded(self) -> bool:
-    return self._signature is not None
-
-  def close(self) -> None:
-    self._loaded_module = None
-    self._signature = None
-    self._tf_example_signature = None
-    self._version = None  # see ExportedModelPredictor.close
+  def versioned_variables(self):
+    return self.variables  # raises: no pytree behind a SavedModel

@@ -53,6 +53,35 @@ def _schedule_barrier_jvp(primals, tangents):
   return _schedule_barrier(x), dx
 
 
+def _register_barrier_batch_rule() -> None:
+  """``optimization_barrier`` also ships no vmap rule on jax 0.4.x.
+
+  The barrier is elementwise identity, so batching it is the barrier on
+  the batched operands with the batch dims passed straight through.
+  Needed by the serving megabatch program (ISSUE 8):
+  ``make_batched_select_action`` vmaps the CEM selector — and the Q
+  tower under it — over the request batch. Registered at import, next
+  to the AD rule above, with the same degrade-to-no-op posture when the
+  internals move.
+  """
+  try:
+    from jax._src.lax import lax as _lax_internal
+    from jax.interpreters import batching as _batching
+    prim = _lax_internal.optimization_barrier_p
+  except (ImportError, AttributeError):  # newer jax: rule ships built-in
+    return
+  if prim in _batching.primitive_batchers:
+    return
+
+  def _rule(args, dims):
+    return prim.bind(*args), list(dims)
+
+  _batching.primitive_batchers[prim] = _rule
+
+
+_register_barrier_batch_rule()
+
+
 NUM_LAYERS = 19
 BATCH_SIZE = 64
 # Action samples when estimating max_a Q(s, a) (ref :37-41).

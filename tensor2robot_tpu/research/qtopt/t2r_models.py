@@ -410,3 +410,58 @@ class Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
       return best, objective(best[None])[0]
 
     return select
+
+  def serving_feature_spec(self, image_shape=(512, 640, 3)):
+    """Per-REQUEST feature contract for the serving layer (ISSUE 8).
+
+    ``{name: (shape, dtype)}`` with no batch dim — what one
+    ``SelectAction`` request carries and what ``PolicyServer`` validates
+    and pads. ``image_shape`` is the RAW camera frame (the selector's
+    own preprocessor crops to TARGET_SHAPE on device), so it is a
+    deployment knob, not a model constant. ``bin/t2r_serve`` derives
+    its spec and AOT shapes from this hook; any model exposing it plus
+    ``make_batched_select_action`` serves through the generic path.
+    """
+    return {
+        'image': (tuple(image_shape), np.uint8),
+        'gripper_closed': ((), np.float32),
+        'height_to_bottom': ((), np.float32),
+    }
+
+  def make_batched_select_action(self,
+                                 cem_samples: int = 64,
+                                 cem_iters: int = 3,
+                                 num_elites: int = 10):
+    """The serving megabatch program: B independent CEM selects, one
+    dispatch (ISSUE 8).
+
+    ``vmap`` of :meth:`make_on_device_select_action` over a leading
+    state-batch dim — each row runs its own full CEM loop (its own
+    ``cem_samples x cem_iters`` critic megabatch), so a PolicyServer
+    batch of B coalesced robot requests is ONE XLA program scoring
+    ``B * cem_samples`` candidates per iteration on the MXU.
+
+    Returns ``batch_select(variables, states, seed) -> outputs`` with
+    ``states`` = {'image' uint8 [B, 512, 640, 3], 'gripper_closed' [B],
+    'height_to_bottom' [B]}, ``seed`` a uint32 scalar (each row gets
+    ``fold_in(seed, row)``), and outputs {'action' [B, 8], 'q' [B]} —
+    the (variables, features, seed) contract
+    ``serving.PolicyServer`` batches through and
+    ``serving.artifact.load_or_compile`` AOT-compiles.
+    """
+    import jax
+
+    select = self.make_on_device_select_action(
+        cem_samples=cem_samples, cem_iters=cem_iters,
+        num_elites=num_elites)
+    batched = jax.vmap(select, in_axes=(None, 0, 0))
+
+    def batch_select(variables, states, seed):
+      batch = jax.tree_util.tree_leaves(states)[0].shape[0]
+      keys = jax.vmap(
+          lambda i: jax.random.fold_in(jax.random.PRNGKey(seed), i))(
+              jnp.arange(batch, dtype=jnp.uint32))
+      actions, q = batched(variables, dict(states), keys)
+      return {'action': actions, 'q': q}
+
+    return batch_select

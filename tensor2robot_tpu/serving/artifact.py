@@ -1,0 +1,212 @@
+"""AOT serving executables: compile at startup, never at request time.
+
+The serving half of the ``CompiledArtifact`` story (ROADMAP item 5,
+first slice): the server's batch program is lowered and compiled ONCE at
+startup — under the ``tuning/`` cache winner's compiler options for this
+exact workload+shapes+chip key, so the server runs the same config it
+was tuned under — and the compiled executable is **serialized to disk
+alongside the cache entry**. A warm restart deserializes it and skips
+even the startup compile; a cold start (or a stale artifact: different
+jax version, different chip, changed shapes) falls back to one AOT
+compile and re-persists. Either way there is NOTHING left to compile by
+the time the first request arrives, which the bench asserts via the
+``jax/compiles`` counter (``serving.request_time_compiles == 0``).
+
+Artifact files are atomic (tmp + rename), self-describing, and advisory:
+any failure to load — corrupt pickle, jaxlib that cannot deserialize,
+schema drift — degrades to the startup compile, never to a dead server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Optional
+
+from tensor2robot_tpu.reliability.logutil import log_warning
+from tensor2robot_tpu.tuning import autotuner
+from tensor2robot_tpu.tuning import cache as cache_lib
+from tensor2robot_tpu.tuning import search_space
+
+__all__ = ['ServingExecutable', 'artifact_path_for_key', 'load_or_compile',
+           'ARTIFACT_SCHEMA', 'ARTIFACT_DIRNAME']
+
+ARTIFACT_SCHEMA = 't2r.serving_artifact.v1'
+ARTIFACT_DIRNAME = 'artifacts'
+
+
+@dataclasses.dataclass
+class ServingExecutable:
+  """One ready-to-call serving program + its provenance.
+
+  ``from_cache`` True means the executable was DESERIALIZED (warm
+  restart, zero XLA compiles this startup); False means one startup AOT
+  compile happened. ``config_id`` names the tuning winner applied
+  ('baseline' when the workload was never tuned or the winner carries
+  model overrides the serving layer cannot re-apply).
+  """
+
+  executable: Any
+  key: str
+  workload: str
+  config_id: str
+  from_cache: bool
+  path: str
+
+
+def artifact_path_for_key(cache_path: str, key: str) -> str:
+  """``<cache dir>/artifacts/<sha1(key)>.pkl`` — alongside the cache
+  file, so one directory carries both the tuning evidence and the
+  executable it picked."""
+  digest = hashlib.sha1(key.encode('utf-8')).hexdigest()[:20]
+  return os.path.join(os.path.dirname(cache_path) or '.',
+                      ARTIFACT_DIRNAME, digest + '.pkl')
+
+
+def _winner_for_entry(entry) -> Optional[search_space.CompileConfig]:
+  """The applicable tuning winner, or None (baseline compile).
+
+  Mirrors the trainer's refusal to half-apply: a winner carrying
+  ``model_overrides`` changed the MODEL the sweep measured; compiler
+  options alone would attribute a config that never ran.
+  """
+  if not entry or not entry.get('winner_ok', True):
+    return None
+  try:
+    winner = search_space.CompileConfig.from_dict(entry['winner'])
+  except (KeyError, TypeError, ValueError):
+    return None
+  if winner.model_overrides:
+    return None
+  return winner
+
+
+def _try_load(path: str, key: str, device_kind: str,
+              expected_config_id: str):
+  """Deserializes a persisted executable; None on any mismatch/corruption.
+
+  ``expected_config_id`` is the CURRENT tuning-cache winner for this
+  key: an artifact compiled under a different config is stale — a
+  re-swept cache whose winner moved must trigger a fresh startup compile
+  under the new winner, not silently keep serving the old program.
+  """
+  if not os.path.exists(path):
+    return None
+  try:
+    with open(path, 'rb') as f:
+      payload = pickle.load(f)
+    if (payload.get('schema') != ARTIFACT_SCHEMA
+        or payload.get('key') != key
+        or payload.get('device_kind') != device_kind):
+      return None
+    if str(payload.get('config_id', 'baseline')) != expected_config_id:
+      log_warning('Serving artifact %s was compiled under config %r but '
+                  'the tuning cache now names %r; recompiling.', path,
+                  payload.get('config_id'), expected_config_id)
+      return None
+    import jax
+    from jax.experimental import serialize_executable
+
+    if payload.get('jax_version') != jax.__version__:
+      return None
+    return serialize_executable.deserialize_and_load(
+        payload['serialized'], payload['in_tree'], payload['out_tree'])
+  except Exception as e:  # noqa: BLE001 — stale/corrupt artifact
+    log_warning('Serving artifact %s failed to load (%s); falling back '
+                'to a startup compile.', path, e)
+    return None
+
+
+def _persist(path: str, key: str, workload: str, device_kind: str,
+             config_id: str, compiled) -> bool:
+  """Serializes ``compiled`` to ``path`` atomically; False if the
+  backend/executable does not support serialization."""
+  try:
+    from jax.experimental import serialize_executable
+    import jax
+
+    serialized, in_tree, out_tree = serialize_executable.serialize(compiled)
+    payload = {
+        'schema': ARTIFACT_SCHEMA,
+        'key': key,
+        'workload': workload,
+        'device_kind': device_kind,
+        'jax_version': jax.__version__,
+        'config_id': config_id,
+        'serialized': serialized,
+        'in_tree': in_tree,
+        'out_tree': out_tree,
+    }
+    directory = os.path.dirname(path) or '.'
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix='.tmp')
+    try:
+      with os.fdopen(fd, 'wb') as f:
+        pickle.dump(payload, f)
+      os.replace(tmp, path)
+    finally:
+      if os.path.exists(tmp):
+        os.unlink(tmp)
+    return True
+  except Exception as e:  # noqa: BLE001 — e.g. backend without PJRT
+    # serialization; the server still starts, it just cold-compiles.
+    log_warning('Could not persist serving executable for %s: %s',
+                workload, e)
+    return False
+
+
+def load_or_compile(workload: str,
+                    jitted,
+                    example_args,
+                    cache: Optional[cache_lib.ConfigCache] = None,
+                    cache_path: Optional[str] = None,
+                    persist: bool = True) -> ServingExecutable:
+  """The server-startup path: deserialize, else AOT-compile + persist.
+
+  Args:
+    workload: cache-key name, e.g. ``serving_qtopt_cem_b8``.
+    jitted: the ``jax.jit`` object for the batch program.
+    example_args: concrete or abstract (ShapeDtypeStruct) argument
+      pytree — fixes the ONE shape the executable serves.
+    cache / cache_path: the tuning cache holding this workload's winner;
+      defaults to the process tuning cache.
+    persist: serialize a freshly-compiled executable back to disk (and
+      stamp its path into the cache entry when one exists).
+  """
+  import jax
+
+  if cache is None:
+    cache = cache_lib.ConfigCache(cache_path)
+  device_kind = getattr(jax.devices()[0], 'device_kind', 'unknown')
+  signature = cache_lib.abstract_signature(example_args)
+  key = cache_lib.cache_key(workload, signature, device_kind)
+  path = artifact_path_for_key(cache.path, key)
+
+  # Resolve the CURRENT winner first: a persisted executable is only
+  # valid if it was compiled under the config the cache names today.
+  entry = cache.lookup(key)
+  winner = _winner_for_entry(entry)
+  config_id = winner.config_id if winner is not None else 'baseline'
+
+  executable = _try_load(path, key, device_kind,
+                         expected_config_id=config_id)
+  if executable is not None:
+    return ServingExecutable(executable=executable, key=key,
+                             workload=workload, config_id=config_id,
+                             from_cache=True, path=path)
+
+  compiled = autotuner.compile_with_config(jitted, example_args, winner)
+  persisted = persist and _persist(path, key, workload, device_kind,
+                                   config_id, compiled)
+  if persisted and entry is not None:
+    # The cache entry gains a pointer to its executable — the first
+    # slice of the unified CompiledArtifact (ROADMAP item 5).
+    entry = dict(entry)
+    entry['serialized_executable'] = path
+    cache.store(key, entry)
+  return ServingExecutable(executable=compiled, key=key, workload=workload,
+                           config_id=config_id, from_cache=False,
+                           path=path if persisted else '')

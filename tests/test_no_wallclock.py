@@ -24,7 +24,11 @@ import os
 # 7): the stage busy/idle accounting in pipeline.py / input_generators.py
 # / device_feed.py / native_loader.py is all durations, which must come
 # from time.perf_counter (the C++ twin uses std::chrono::steady_clock).
-SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data')
+# 'serving' joined with ISSUE 8: batching deadlines, SLO latencies, and
+# report windows are durations — a wall-clock jump must not dispatch an
+# under-age batch or fabricate a p99.
+SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
+                    'serving')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -150,3 +150,121 @@ def test_exported_predictor_picks_newest_and_survives_gc(exported, trained):
   assert predictor.restore()
   assert predictor.global_step == 7
   predictor.close()
+
+
+# -- hot-swap race regression (ISSUE 8 satellite) -----------------------------
+#
+# The versioned-params contract the serving layer relies on: a restore()
+# landing DURING a predict must never produce a mixed-version result —
+# outputs computed by one checkpoint's weights labeled with another's
+# version, or (worse, the pre-PR-8 ExportedModelPredictor) a serve
+# function from one export paired with another export's variables. Both
+# predictors now keep their loaded state in ONE atomically-swapped
+# snapshot; these tests hammer predict_versioned against a swap loop and
+# check every response is internally consistent with exactly one version.
+
+import threading  # noqa: E402
+
+from tensor2robot_tpu.trainer import checkpointing  # noqa: E402
+
+
+def test_checkpoint_predictor_no_mixed_version_under_concurrent_swap(
+    tmp_path):
+  model_dir = str(tmp_path / 'run')
+  generator = MockInputGenerator(batch_size=8)
+  trainer = Trainer(MockT2RModel(), model_dir, async_checkpoints=False,
+                    save_checkpoints_steps=1)
+  trainer.train(generator, max_train_steps=2)
+  trainer.close()
+  features, _ = next(generator.create_dataset_iterator(mode=ModeKeys.TRAIN))
+  feats = features.to_dict()
+  steps = checkpointing.all_checkpoint_steps(model_dir)
+  assert len(steps) >= 2
+
+  # Per-step expected outputs from throwaway predictors.
+  expected = {}
+  for step in steps:
+    loader = CheckpointPredictor(MockT2RModel(), model_dir, timeout=5.0)
+    assert loader._load_step(step)
+    expected[step] = loader.predict(feats)['logits']
+  # The versions must be distinguishable or mixing would be invisible.
+  assert not np.allclose(expected[steps[0]], expected[steps[-1]])
+
+  predictor = CheckpointPredictor(MockT2RModel(), model_dir, timeout=5.0)
+  assert predictor._load_step(steps[0])
+  stop = threading.Event()
+  swap_errors = []
+
+  def swapper():
+    while not stop.is_set():
+      for step in steps:
+        try:
+          predictor._load_step(step)
+        except Exception as e:  # noqa: BLE001
+          swap_errors.append(e)
+          return
+
+  thread = threading.Thread(target=swapper)
+  thread.start()
+  try:
+    for _ in range(60):
+      outputs, version = predictor.predict_versioned(feats)
+      np.testing.assert_allclose(outputs['logits'], expected[version],
+                                 rtol=1e-6, atol=1e-6)
+  finally:
+    stop.set()
+    thread.join()
+  assert not swap_errors
+  predictor.close()
+
+
+def test_exported_predictor_no_mixed_version_under_concurrent_swap(
+    trained, tmp_path):
+  trainer, state, features = trained
+  root = str(tmp_path / 'exports')
+  generator = DefaultExportGenerator()
+  generator.set_specification_from_model(trainer.model)
+  variables = jax.device_get(state.variables())
+  # Two versions with deliberately different weights.
+  scaled = jax.tree_util.tree_map(lambda x: x * 1.5, variables)
+  generator.export(root, variables, global_step=3, batch_size=16,
+                   version=1)
+  generator.export(root, scaled, global_step=4, batch_size=16, version=2)
+
+  predictor = ExportedModelPredictor(root, t2r_model=MockT2RModel(),
+                                     timeout=5.0)
+  assert predictor.restore()
+  feats = features.to_dict()
+  expected = {}
+  for version in (1, 2):
+    assert predictor._try_load_version(version)
+    expected[version] = predictor.predict(feats)['logits']
+  assert not np.allclose(expected[1], expected[2])
+
+  stop = threading.Event()
+  swap_errors = []
+
+  def swapper():
+    while not stop.is_set():
+      for version in (1, 2):
+        try:
+          predictor._try_load_version(version)
+        except Exception as e:  # noqa: BLE001
+          swap_errors.append(e)
+          return
+
+  thread = threading.Thread(target=swapper)
+  thread.start()
+  try:
+    for _ in range(200):
+      outputs, version = predictor.predict_versioned(feats)
+      np.testing.assert_allclose(outputs['logits'], expected[version],
+                                 rtol=1e-6, atol=1e-6)
+      # The spec/parser half of the snapshot must ride the same swap:
+      spec = predictor.get_feature_specification()
+      assert 'measured_position' in dict(spec)
+  finally:
+    stop.set()
+    thread.join()
+  assert not swap_errors
+  predictor.close()

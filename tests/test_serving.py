@@ -1,0 +1,765 @@
+"""Serving subsystem tests (ISSUE 8): batcher contract, padding,
+admission, PolicyServer end-to-end (hot swap, SLO records, errors),
+AOT artifact persistence, the HTTP frontend, SLO-resolution histogram
+buckets, and the doctor/CI-gate serving section.
+
+Everything except the artifact tests is CPU-only with NO device program:
+the server executes an injected ``batch_fn``, which is the point — the
+whole batching / versioned-swap / SLO contract is host logic.
+"""
+
+import http.client
+import importlib.machinery
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.observability import (
+    DEFAULT_LATENCY_BUCKETS_MS,
+    Histogram,
+    SLO_LATENCY_BUCKETS_MS,
+    TelemetryRegistry,
+    read_telemetry,
+    set_registry,
+)
+from tensor2robot_tpu.observability import doctor
+from tensor2robot_tpu.serving import (
+    DeadlineBatcher,
+    PolicyServer,
+    RequestRejected,
+    ServingConfig,
+    load_or_compile,
+    pad_batch,
+    split_outputs,
+)
+from tensor2robot_tpu.serving.admission import AdmissionController
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def registry():
+  """Fresh process registry per test (serving metrics are process-wide)."""
+  fresh = TelemetryRegistry()
+  previous = set_registry(fresh)
+  yield fresh
+  set_registry(previous)
+
+
+def _state(value, size=3):
+  return {'x': np.full((size,), value, np.float32)}
+
+
+def _echo_batch_fn(variables, features, seed):
+  """Scores rows with the params' scale; echoes the version per row."""
+  x = features['x']
+  return {'y': x * variables['scale'],
+          'version': np.full((x.shape[0],), variables['version'],
+                             np.int64)}
+
+
+# -- batcher contract --------------------------------------------------------
+
+
+class TestDeadlineBatcher:
+
+  def test_burst_dispatches_full_batch_immediately(self):
+    batcher = DeadlineBatcher(max_batch_size=4, max_wait_ms=10_000.0)
+    for i in range(9):
+      batcher.submit(_state(i))
+    start = time.perf_counter()
+    first = batcher.next_batch(timeout=1.0)
+    elapsed = time.perf_counter() - start
+    # A full batch must NOT wait for the deadline (10s here).
+    assert elapsed < 1.0
+    assert [r.features['x'][0] for r in first] == [0, 1, 2, 3]  # FIFO
+    second = batcher.next_batch(timeout=1.0)
+    assert [r.features['x'][0] for r in second] == [4, 5, 6, 7]
+    assert batcher.pending_count() == 1
+
+  def test_trickle_honors_max_wait(self):
+    batcher = DeadlineBatcher(max_batch_size=8, max_wait_ms=80.0)
+    batcher.submit(_state(1))
+    start = time.perf_counter()
+    batch = batcher.next_batch(timeout=5.0)
+    elapsed = time.perf_counter() - start
+    assert len(batch) == 1
+    # Dispatched once the oldest request aged max_wait: no earlier than
+    # the deadline (minus scheduler slop), no parked-forever behavior.
+    assert 0.06 <= elapsed < 2.0
+
+  def test_deadline_runs_from_oldest_request(self):
+    clock = [0.0]
+    batcher = DeadlineBatcher(max_batch_size=8, max_wait_ms=100.0,
+                              clock=lambda: clock[0])
+    batcher.submit(_state(1))
+    clock[0] = 0.09
+    batcher.submit(_state(2))  # young request must not reset the clock
+    clock[0] = 0.101
+    batch = batcher.next_batch(timeout=0.0)
+    assert batch is not None and len(batch) == 2
+
+  def test_timeout_returns_none(self):
+    batcher = DeadlineBatcher(max_batch_size=4, max_wait_ms=50.0)
+    assert batcher.next_batch(timeout=0.05) is None
+
+  def test_close_drains_then_terminates(self):
+    batcher = DeadlineBatcher(max_batch_size=8, max_wait_ms=10_000.0)
+    for i in range(3):
+      batcher.submit(_state(i))
+    batcher.close()
+    batch = batcher.next_batch(timeout=1.0)
+    assert len(batch) == 3  # under-full final batch, immediate
+    assert batcher.next_batch(timeout=0.01) is None
+    with pytest.raises(RuntimeError):
+      batcher.submit(_state(9))
+
+
+# -- padding -----------------------------------------------------------------
+
+
+class TestPadding:
+
+  def test_pad_replicates_last_row_and_reports_real_count(self):
+    batched, n_real = pad_batch([_state(1), _state(2)], pad_to=4)
+    assert n_real == 2
+    assert batched['x'].shape == (4, 3)
+    np.testing.assert_array_equal(batched['x'][1], batched['x'][2])
+    np.testing.assert_array_equal(batched['x'][1], batched['x'][3])
+
+  def test_scalars_stack_to_vector(self):
+    batched, _ = pad_batch([{'s': np.float32(1)}, {'s': np.float32(2)}],
+                           pad_to=2)
+    assert batched['s'].shape == (2,)
+
+  def test_mismatched_features_raise(self):
+    with pytest.raises(ValueError, match='disagree'):
+      pad_batch([{'a': np.zeros(2)}, {'b': np.zeros(2)}], pad_to=4)
+
+  def test_overflow_and_empty_raise(self):
+    with pytest.raises(ValueError):
+      pad_batch([_state(i) for i in range(5)], pad_to=4)
+    with pytest.raises(ValueError):
+      pad_batch([], pad_to=4)
+
+  def test_split_never_leaks_padded_rows(self):
+    outputs = {'y': np.arange(8).reshape(4, 2)}
+    rows = split_outputs(outputs, n_real=2)
+    assert len(rows) == 2  # rows 2..3 (the padding) are unreachable
+    np.testing.assert_array_equal(rows[1]['y'], [2, 3])
+
+  def test_split_rejects_short_leading_dim(self):
+    with pytest.raises(ValueError, match='leading dim'):
+      split_outputs({'y': np.zeros((2, 2))}, n_real=3)
+
+
+# -- admission ---------------------------------------------------------------
+
+
+class TestAdmission:
+
+  def test_rejects_at_depth_and_counts(self, registry):
+    controller = AdmissionController(max_queue_depth=2, registry=registry)
+    controller.admit(0)
+    controller.admit(1)
+    with pytest.raises(RequestRejected):
+      controller.admit(2)
+    with pytest.raises(RequestRejected):
+      controller.admit(5)
+    assert controller.rejected_total == 2
+
+  def test_server_sheds_load_when_saturated(self, registry, tmp_path):
+    release = threading.Event()
+
+    def blocked_batch_fn(variables, features, seed):
+      release.wait(5.0)
+      return _echo_batch_fn(variables, features, seed)
+
+    config = ServingConfig(max_batch_size=2, max_wait_ms=1.0,
+                           max_queue_depth=3, report_interval_s=60.0)
+    server = PolicyServer(blocked_batch_fn,
+                          {'scale': 1.0, 'version': 1}, config, version=1)
+    server.start()
+    try:
+      futures = []
+      # The first batch blocks in the executor; then fill the queue.
+      deadline = time.perf_counter() + 5.0
+      rejected = 0
+      while time.perf_counter() < deadline:
+        try:
+          futures.append(server.submit(_state(1)))
+        except RequestRejected:
+          rejected += 1
+          break
+      assert rejected >= 1
+      assert server.stats()['rejected_total'] >= 1
+      release.set()
+      for future in futures:
+        future.result(timeout=5.0)  # admitted requests all complete
+    finally:
+      release.set()
+      server.close()
+
+
+# -- PolicyServer end-to-end -------------------------------------------------
+
+
+class TestPolicyServer:
+
+  def test_batches_coalesce_and_answers_match_requests(self, registry,
+                                                       tmp_path):
+    config = ServingConfig(max_batch_size=4, max_wait_ms=5.0,
+                           report_interval_s=0.05)
+    server = PolicyServer(_echo_batch_fn, {'scale': 2.0, 'version': 1},
+                          config, version=1, model_dir=str(tmp_path),
+                          feature_spec={'x': ((3,), np.float32)})
+    with server:
+      futures = [server.submit(_state(i)) for i in range(10)]
+      results = [f.result(timeout=5.0) for f in futures]
+    for i, result in enumerate(results):
+      np.testing.assert_allclose(result.outputs['y'], i * 2.0)
+      assert result.version == 1
+      assert result.latency_ms >= 0.0
+    stats = server.stats()
+    assert stats['requests_total'] == 10
+    assert stats['batches_total'] >= 3  # 10 requests / max 4
+    records = read_telemetry(str(tmp_path))
+    kinds = [r['kind'] for r in records]
+    assert kinds[0] == 'serving_start'
+    assert kinds[-1] == 'serving_stop'
+    assert 'serving' in kinds
+    serving = [r for r in records if r['kind'] == 'serving']
+    assert sum(r['requests'] for r in serving) == 10
+    assert all(r['slo_ms'] == 33.0 for r in serving)
+
+  def test_padded_rows_never_reach_responses(self, registry):
+    seen = []
+
+    def asserting_batch_fn(variables, features, seed):
+      assert features['x'].shape[0] == 4  # always the padded shape
+      seen.append(features['x'].copy())
+      return {'y': features['x'][:, 0]}
+
+    config = ServingConfig(max_batch_size=4, max_wait_ms=1.0)
+    server = PolicyServer(asserting_batch_fn, {'version': 1}, config)
+    with server:
+      futures = [server.submit(_state(i)) for i in range(3)]
+      results = [f.result(timeout=5.0) for f in futures]
+    values = sorted(float(r.outputs['y']) for r in results)
+    assert values == [0.0, 1.0, 2.0]
+    assert server.stats()['padding_waste_total'] >= 1.0
+
+  def test_spec_violation_fails_caller_not_batch(self, registry):
+    config = ServingConfig(max_batch_size=2, max_wait_ms=1.0)
+    server = PolicyServer(_echo_batch_fn, {'scale': 1.0, 'version': 1},
+                          config, feature_spec={'x': ((3,), np.float32)})
+    with server:
+      with pytest.raises(ValueError, match='shape'):
+        server.submit({'x': np.zeros((7,), np.float32)})
+      with pytest.raises(ValueError, match='do not match'):
+        server.submit({'wrong': np.zeros((3,), np.float32)})
+      result = server.select_action(_state(1), timeout_s=5.0)
+      np.testing.assert_allclose(result.outputs['y'], 1.0)
+
+  def test_batch_failure_answers_callers_and_keeps_serving(self, registry):
+    fail = threading.Event()
+    fail.set()
+
+    def flaky_batch_fn(variables, features, seed):
+      if fail.is_set():
+        raise RuntimeError('injected batch failure')
+      return _echo_batch_fn(variables, features, seed)
+
+    config = ServingConfig(max_batch_size=2, max_wait_ms=1.0)
+    server = PolicyServer(flaky_batch_fn, {'scale': 1.0, 'version': 1},
+                          config)
+    with server:
+      future = server.submit(_state(1))
+      with pytest.raises(RuntimeError, match='injected'):
+        future.result(timeout=5.0)
+      fail.clear()
+      result = server.select_action(_state(2), timeout_s=5.0)
+      np.testing.assert_allclose(result.outputs['y'], 2.0)
+    assert server.stats()['errors_total'] >= 1.0
+
+  def test_hot_swap_under_load_zero_dropped_no_mixed_versions(
+      self, registry, tmp_path):
+    """The acceptance-shaped test: swap mid-load; every request completes
+    and every response's outputs match the version that labels it."""
+
+    def slowish_batch_fn(variables, features, seed):
+      time.sleep(0.002)  # keep batches in flight across the swap
+      return _echo_batch_fn(variables, features, seed)
+
+    config = ServingConfig(max_batch_size=4, max_wait_ms=2.0,
+                           max_queue_depth=10_000,
+                           report_interval_s=0.05)
+    server = PolicyServer(slowish_batch_fn, {'scale': 2.0, 'version': 1},
+                          config, version=1, model_dir=str(tmp_path))
+    results = []
+    failures = []
+    stop = threading.Event()
+
+    def client(value):
+      while not stop.is_set():
+        try:
+          results.append((value,
+                          server.select_action(_state(value),
+                                               timeout_s=10.0)))
+        except Exception as e:  # noqa: BLE001 — any failure fails the test
+          failures.append(e)
+
+    with server:
+      threads = [threading.Thread(target=client, args=(i,))
+                 for i in range(8)]
+      for t in threads:
+        t.start()
+      time.sleep(0.15)
+      server.swap_params({'scale': 3.0, 'version': 2}, version=2)
+      time.sleep(0.15)
+      stop.set()
+      for t in threads:
+        t.join()
+    assert not failures  # zero dropped/failed requests across the swap
+    versions = {r.version for _, r in results}
+    assert versions == {1, 2}  # both weights actually served
+    for value, result in results:
+      scale = {1: 2.0, 2: 3.0}[result.version]
+      # outputs computed by one version, labeled with that version —
+      # never params from one and a label from the other.
+      np.testing.assert_allclose(result.outputs['y'], value * scale)
+      assert int(result.outputs['version']) == result.version
+    records = read_telemetry(str(tmp_path))
+    swaps = [r for r in records if r['kind'] == 'serving_swap']
+    assert len(swaps) == 1 and swaps[0]['version'] == 2
+    assert server.stats()['swaps_total'] == 1.0
+
+  def test_swap_from_predictor_uses_versioned_snapshot(self, registry):
+    class FakePredictor:
+      versioned_variables = (7, {'scale': 5.0, 'version': 7})
+
+    config = ServingConfig(max_batch_size=2, max_wait_ms=1.0)
+    server = PolicyServer(_echo_batch_fn, {'scale': 1.0, 'version': 1},
+                          config, version=1)
+    with server:
+      assert server.swap_from_predictor(FakePredictor())
+      assert not server.swap_from_predictor(FakePredictor())  # same version
+      result = server.select_action(_state(2), timeout_s=5.0)
+    assert result.version == 7
+    np.testing.assert_allclose(result.outputs['y'], 10.0)
+
+  def test_over_slo_window_is_flagged_and_doctor_pages(self, registry,
+                                                       tmp_path):
+    def slow_batch_fn(variables, features, seed):
+      time.sleep(0.01)
+      return _echo_batch_fn(variables, features, seed)
+
+    config = ServingConfig(max_batch_size=2, max_wait_ms=1.0,
+                           slo_ms=1.0,  # 10 ms batches: every window over
+                           report_interval_s=0.05)
+    server = PolicyServer(slow_batch_fn, {'scale': 1.0, 'version': 1},
+                          config, model_dir=str(tmp_path))
+    with server:
+      for _ in range(6):
+        server.select_action(_state(1), timeout_s=5.0)
+      time.sleep(0.1)  # let a report window close while live
+      records = read_telemetry(str(tmp_path))
+      over = [r for r in records if r.get('kind') == 'serving'
+              and r.get('over_slo')]
+      assert over, 'no over_slo serving window was reported'
+      # Doctor, while the server is LIVE (heartbeat fresh, no stop):
+      findings = doctor.diagnose(str(tmp_path))
+      crit = [f for f in findings if f['severity'] == doctor.CRITICAL]
+      assert any('SLO' in f['message'] for f in crit)
+    # After the orderly stop the same history downgrades to WARNING.
+    findings = doctor.diagnose(str(tmp_path))
+    assert not any(f['severity'] == doctor.CRITICAL for f in findings)
+    assert any('SLO' in f['message'] for f in findings
+               if f['severity'] == doctor.WARNING)
+
+
+# -- SLO-resolution histogram edges (ISSUE 8 satellite) ----------------------
+
+
+class TestSloLatencyBuckets:
+
+  def test_default_edges_are_too_coarse_at_the_slo(self):
+    # The regression the satellite names: the default x2 edges bracket
+    # 33 ms with a ~26 ms-wide bucket — p99 there is a guess.
+    below = max(b for b in DEFAULT_LATENCY_BUCKETS_MS if b < 33.0)
+    above = min(b for b in DEFAULT_LATENCY_BUCKETS_MS if b >= 33.0)
+    assert above - below > 20.0
+
+  def test_slo_edges_have_1ms_resolution_at_33ms(self):
+    below = max(b for b in SLO_LATENCY_BUCKETS_MS if b < 33.0)
+    above = min(b for b in SLO_LATENCY_BUCKETS_MS if b >= 33.0)
+    assert above - below <= 1.0
+    assert min(SLO_LATENCY_BUCKETS_MS) < 1.0  # sub-ms floor
+    assert max(b for b in SLO_LATENCY_BUCKETS_MS if b <= 100.0) == 100.0
+
+  def test_p99_interpolation_error_under_one_bucket_width(self):
+    # Latencies clustered around the SLO band; p99 lands near 33 ms.
+    rng = np.random.RandomState(7)
+    values = np.clip(rng.lognormal(np.log(15.0), 0.35, 30_000),
+                     0.05, 400.0)
+    hist = Histogram(SLO_LATENCY_BUCKETS_MS)
+    for value in values:
+      hist.record(float(value))
+    true_p99 = float(np.percentile(values, 99))
+    assert 20.0 < true_p99 < 60.0  # the band the edges must resolve
+    edges = (0.0,) + tuple(SLO_LATENCY_BUCKETS_MS)
+    bucket_width = next(b - a for a, b in zip(edges, edges[1:])
+                        if a < true_p99 <= b)
+    assert bucket_width <= 1.0
+    assert abs(hist.percentile(99.0) - true_p99) < bucket_width
+
+  def test_per_series_bounds_leave_siblings_on_defaults(self):
+    registry = TelemetryRegistry()
+    family = registry.histogram_family(
+        'inference/latency_ms', ('predictor',),
+        bounds=DEFAULT_LATENCY_BUCKETS_MS)
+    plain = family.series('CheckpointPredictor')
+    slo = family.series('serving_request', bounds=SLO_LATENCY_BUCKETS_MS)
+    assert plain.state()['bounds'] == list(DEFAULT_LATENCY_BUCKETS_MS)
+    assert slo.state()['bounds'] == list(SLO_LATENCY_BUCKETS_MS)
+    # Idempotent re-lookup, with or without the explicit bounds:
+    assert family.series('serving_request') is slo
+    assert family.series('serving_request',
+                         bounds=SLO_LATENCY_BUCKETS_MS) is slo
+    with pytest.raises(ValueError, match='bounds'):
+      family.series('serving_request', bounds=(1.0, 2.0))
+    with pytest.raises(ValueError, match='histogram'):
+      registry.counter_family('c', ('a',)).series('x', bounds=(1.0,))
+
+
+# -- AOT artifact ------------------------------------------------------------
+
+
+class TestServingArtifact:
+
+  def _jitted(self):
+    import jax
+
+    def step(variables, features, seed):
+      del seed
+      return {'y': features['x'] * variables['scale']}
+
+    example = ({'scale': jax.ShapeDtypeStruct((), 'float32')},
+               {'x': jax.ShapeDtypeStruct((4, 3), 'float32')},
+               jax.ShapeDtypeStruct((), 'uint32'))
+    return jax.jit(step), example
+
+  def test_compile_persist_then_warm_restart_deserializes(self, tmp_path):
+    from tensor2robot_tpu.tuning import cache as cache_lib
+
+    cache = cache_lib.ConfigCache(str(tmp_path / 'tuning_cache.json'))
+    jitted, example = self._jitted()
+    first = load_or_compile('serve_artifact_test', jitted, example,
+                            cache=cache)
+    assert not first.from_cache and os.path.exists(first.path)
+    out = first.executable({'scale': np.float32(2.0)},
+                           {'x': np.ones((4, 3), np.float32)},
+                           np.uint32(0))
+    np.testing.assert_allclose(np.asarray(out['y']), 2.0)
+    # Warm restart: a FRESH jit object is never lowered or compiled —
+    # the persisted executable is deserialized and runs.
+    jitted2, _ = self._jitted()
+    second = load_or_compile('serve_artifact_test', jitted2, example,
+                             cache=cache)
+    assert second.from_cache
+    out = second.executable({'scale': np.float32(3.0)},
+                            {'x': np.ones((4, 3), np.float32)},
+                            np.uint32(1))
+    np.testing.assert_allclose(np.asarray(out['y']), 3.0)
+
+  def test_shape_change_is_a_different_artifact(self, tmp_path):
+    import jax
+
+    from tensor2robot_tpu.tuning import cache as cache_lib
+
+    cache = cache_lib.ConfigCache(str(tmp_path / 'tuning_cache.json'))
+    jitted, example = self._jitted()
+    first = load_or_compile('serve_artifact_test', jitted, example,
+                            cache=cache)
+    other = ({'scale': jax.ShapeDtypeStruct((), 'float32')},
+             {'x': jax.ShapeDtypeStruct((8, 3), 'float32')},
+             jax.ShapeDtypeStruct((), 'uint32'))
+    second = load_or_compile('serve_artifact_test', jitted, other,
+                             cache=cache)
+    assert second.key != first.key
+    assert not second.from_cache
+
+  def test_corrupt_artifact_degrades_to_startup_compile(self, tmp_path):
+    from tensor2robot_tpu.tuning import cache as cache_lib
+
+    cache = cache_lib.ConfigCache(str(tmp_path / 'tuning_cache.json'))
+    jitted, example = self._jitted()
+    first = load_or_compile('serve_artifact_test', jitted, example,
+                            cache=cache)
+    with open(first.path, 'wb') as f:
+      f.write(b'not a pickle')
+    second = load_or_compile('serve_artifact_test', jitted, example,
+                             cache=cache)
+    assert not second.from_cache  # recompiled, did not crash
+    out = second.executable({'scale': np.float32(2.0)},
+                            {'x': np.ones((4, 3), np.float32)},
+                            np.uint32(0))
+    np.testing.assert_allclose(np.asarray(out['y']), 2.0)
+
+  def test_winner_change_invalidates_persisted_artifact(self, tmp_path):
+    """A re-swept tuning cache whose winner moved must force a fresh
+    startup compile under the NEW config — never silently keep serving
+    the executable built under the old one."""
+    from tensor2robot_tpu.tuning import cache as cache_lib
+
+    cache = cache_lib.ConfigCache(str(tmp_path / 'tuning_cache.json'))
+    jitted, example = self._jitted()
+    first = load_or_compile('serve_artifact_test', jitted, example,
+                            cache=cache)
+    assert not first.from_cache and first.config_id == 'baseline'
+    # A later sweep names a different winner for the same key:
+    cache.store(first.key, {'winner': {'config_id': 'latency-sched',
+                                       'compiler_options': {}},
+                            'winner_ok': True})
+    second = load_or_compile('serve_artifact_test', self._jitted()[0],
+                             example, cache=cache)
+    assert not second.from_cache  # stale artifact refused, recompiled
+    assert second.config_id == 'latency-sched'
+    third = load_or_compile('serve_artifact_test', self._jitted()[0],
+                            example, cache=cache)
+    assert third.from_cache and third.config_id == 'latency-sched'
+
+  def test_tuning_entry_gains_executable_pointer(self, tmp_path):
+    from tensor2robot_tpu.tuning import cache as cache_lib
+
+    cache = cache_lib.ConfigCache(str(tmp_path / 'tuning_cache.json'))
+    jitted, example = self._jitted()
+    # Pre-existing tuning entry for the same key (a prior sweep): the
+    # artifact path must be stamped alongside the winner.
+    device_kind = _device_kind()
+    signature = cache_lib.abstract_signature(example)
+    key = cache_lib.cache_key('serve_artifact_test', signature, device_kind)
+    cache.store(key, {'winner': {'config_id': 'baseline'},
+                      'winner_ok': True})
+    artifact = load_or_compile('serve_artifact_test', jitted, example,
+                               cache=cache)
+    entry = cache.lookup(key)
+    assert entry['serialized_executable'] == artifact.path
+
+
+def _device_kind():
+  import jax
+
+  return getattr(jax.devices()[0], 'device_kind', 'unknown')
+
+
+# -- HTTP frontend -----------------------------------------------------------
+
+
+class TestHttpFrontend:
+
+  @pytest.fixture()
+  def http_server(self, registry):
+    from tensor2robot_tpu.serving.frontend import build_http_server
+
+    config = ServingConfig(max_batch_size=4, max_wait_ms=2.0)
+    server = PolicyServer(_echo_batch_fn, {'scale': 2.0, 'version': 3},
+                          config, version=3,
+                          feature_spec={'x': ((3,), np.float32)})
+    server.start()
+    httpd, port = build_http_server(server, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield server, port
+    httpd.shutdown()
+    server.close()
+
+  def _post(self, port, path, payload):
+    conn = http.client.HTTPConnection('127.0.0.1', port, timeout=10)
+    conn.request('POST', path, body=json.dumps(payload),
+                 headers={'Content-Type': 'application/json'})
+    response = conn.getresponse()
+    body = json.loads(response.read() or b'{}')
+    conn.close()
+    return response.status, body
+
+  def test_select_action_round_trip(self, http_server):
+    _, port = http_server
+    status, body = self._post(port, '/v1/select_action',
+                              {'features': {'x': [1.0, 2.0, 3.0]}})
+    assert status == 200
+    np.testing.assert_allclose(body['outputs']['y'], [2.0, 4.0, 6.0])
+    assert body['version'] == 3
+    assert body['latency_ms'] >= 0.0
+
+  def test_bad_requests_get_400(self, http_server):
+    _, port = http_server
+    status, body = self._post(port, '/v1/select_action', {'nope': 1})
+    assert status == 400
+    status, body = self._post(port, '/v1/select_action',
+                              {'features': {'x': [1.0]}})  # wrong shape
+    assert status == 400 and 'shape' in body['error']
+    status, _ = self._post(port, '/v1/other', {})
+    assert status == 404
+
+  def test_healthz_and_metricz(self, http_server):
+    server, port = http_server
+    server.select_action({'x': np.ones((3,), np.float32)}, timeout_s=5.0)
+    conn = http.client.HTTPConnection('127.0.0.1', port, timeout=10)
+    conn.request('GET', '/healthz')
+    health = json.loads(conn.getresponse().read())
+    assert health['requests_total'] >= 1
+    assert health['params_version'] == 3
+    conn.request('GET', '/metricz')
+    metrics = json.loads(conn.getresponse().read())
+    conn.close()
+    assert any(tag.startswith('serving/') for tag in metrics)
+    assert 'inference/latency_ms/serving_request/p99' in metrics
+
+
+# -- doctor serving section + CI gate ----------------------------------------
+
+
+def _load_gate_module():
+  """Imports bin/check_serving_slo (extensionless) for its fixture writer."""
+  path = os.path.join(REPO_ROOT, 'bin', 'check_serving_slo')
+  loader = importlib.machinery.SourceFileLoader('check_serving_slo', path)
+  spec = importlib.util.spec_from_loader('check_serving_slo', loader)
+  module = importlib.util.module_from_spec(spec)
+  loader.exec_module(module)
+  return module
+
+
+class TestServingDoctor:
+
+  def test_live_breach_is_critical(self, tmp_path):
+    _load_gate_module().write_serving_run(str(tmp_path), breach=True)
+    findings = doctor.diagnose(str(tmp_path))
+    crit = [f for f in findings if f['severity'] == doctor.CRITICAL]
+    assert any('serving p99' in f['message'] for f in crit)
+
+  def test_recovered_breach_downgrades_to_warning(self, tmp_path):
+    _load_gate_module().write_serving_run(str(tmp_path), breach=True,
+                                          recovered=True)
+    findings = doctor.diagnose(str(tmp_path))
+    assert not any(f['severity'] == doctor.CRITICAL for f in findings)
+    warn = [f for f in findings if f['severity'] == doctor.WARNING]
+    assert any('recovered since' in f['message'] for f in warn)
+
+  def test_clean_run_reports_healthy_serving(self, tmp_path):
+    _load_gate_module().write_serving_run(str(tmp_path), breach=False)
+    findings = doctor.diagnose(str(tmp_path))
+    assert not any(f['severity'] in (doctor.CRITICAL, doctor.WARNING)
+                   for f in findings)
+    assert any('serving healthy' in f['message'] for f in findings)
+
+  def test_check_serving_slo_gate_passes(self):
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'bin',
+                                      'check_serving_slo')],
+        capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stdout + result.stderr
+
+  def test_summarize_prints_serving_section(self, tmp_path):
+    _load_gate_module().write_serving_run(str(tmp_path), breach=False)
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, 'bin', 't2r_telemetry'),
+         'summarize', str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert 'serving:' in result.stdout
+    assert 'p50/p95/p99' in result.stdout
+
+
+# -- post-review regression tests --------------------------------------------
+
+
+class TestReviewFixes:
+
+  def test_concurrent_submits_cannot_overshoot_queue_depth(self, registry):
+    """Admission is checked UNDER the batcher lock: N racing submitters
+    at depth max-1 admit exactly as many as fit, never all N."""
+    batcher = DeadlineBatcher(max_batch_size=64, max_wait_ms=10_000.0)
+    controller = AdmissionController(max_queue_depth=5, registry=registry)
+    barrier = threading.Barrier(16)
+    admitted = []
+    rejected = []
+
+    def submitter(i):
+      barrier.wait()
+      try:
+        admitted.append(batcher.submit(_state(i), admission=controller))
+      except RequestRejected:
+        rejected.append(i)
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(16)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    assert len(admitted) == 5  # exactly max_queue_depth, not 16
+    assert len(rejected) == 11
+    assert batcher.pending_count() == 5
+    assert controller.rejected_total == 11
+
+  def test_serve_loop_survives_telemetry_failure(self, registry, tmp_path):
+    """A failing telemetry writer (full disk) degrades to a warning; the
+    serve loop keeps answering requests instead of silently dying."""
+    server = PolicyServer(_echo_batch_fn, {'scale': 1.0, 'version': 1},
+                          ServingConfig(max_batch_size=2, max_wait_ms=1.0,
+                                        report_interval_s=0.01),
+                          model_dir=str(tmp_path))
+    with server:
+      server.select_action(_state(1), timeout_s=5.0)
+      server._telemetry.close()  # every later log() raises ValueError
+      time.sleep(0.05)  # a report interval elapses against the dead file
+      result = server.select_action(_state(2), timeout_s=5.0)
+      np.testing.assert_allclose(result.outputs['y'], 2.0)
+      # reopen so close() can write its final records cleanly
+      server._telemetry = type(server._telemetry)(str(tmp_path))
+
+  def test_cancelled_future_does_not_kill_the_loop(self, registry):
+    gate = threading.Event()
+
+    def gated_batch_fn(variables, features, seed):
+      gate.wait(5.0)
+      return _echo_batch_fn(variables, features, seed)
+
+    server = PolicyServer(_echo_batch_fn, {'scale': 1.0, 'version': 1},
+                          ServingConfig(max_batch_size=2, max_wait_ms=1.0))
+    with server:
+      future = server.submit(_state(1))
+      future.cancel()  # caller walked away before dispatch
+      gate.set()
+      result = server.select_action(_state(3), timeout_s=5.0)
+      np.testing.assert_allclose(result.outputs['y'], 3.0)
+
+  def test_http_non_object_payloads_get_400(self, registry):
+    from tensor2robot_tpu.serving.frontend import build_http_server
+
+    server = PolicyServer(_echo_batch_fn, {'scale': 1.0, 'version': 1},
+                          ServingConfig(max_batch_size=2, max_wait_ms=1.0))
+    server.start()
+    httpd, port = build_http_server(server, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+      for body in ('[1, 2, 3]', '"x"', '42', 'null'):
+        conn = http.client.HTTPConnection('127.0.0.1', port, timeout=10)
+        conn.request('POST', '/v1/select_action', body=body,
+                     headers={'Content-Type': 'application/json'})
+        response = conn.getresponse()
+        assert response.status == 400, body
+        response.read()
+        conn.close()
+    finally:
+      httpd.shutdown()
+      server.close()
