@@ -47,16 +47,40 @@ per-stage quantity instead of a bench-time inference:
     kinds (``pipeline_stall`` / ``worker_starvation`` /
     ``transfer_regression``) feeding the capture loop.
 
+Fleet observatory (ISSUE 9) lifts all of it from one process to a
+fleet:
+
+  * `fleet.py` — per-host stream federation (``telemetry.<i>.jsonl``
+    merged into aligned step-time/goodput series, fleet goodput as the
+    min across hosts), the FleetWatchdog (``straggler`` /
+    ``host_dead`` anomalies into the same capture loop), the live
+    FleetObserver (``t2r.fleet.v1`` records from per-host heartbeats),
+    and the preemption recovery timeline (``t2r.recovery.v1``,
+    ``preemption_recovery_seconds``).
+  * `fleet_sim.py` — the jax-free simulated-host writer fleet tests,
+    ``bin/check_fleet_doctor``, and the MULTICHIP fleet phase share.
+
 Metric name catalog, forensics report schema, and goodput definitions:
 docs/observability.md.
 """
 
 from tensor2robot_tpu.observability.autoprofiler import AutoProfiler
+from tensor2robot_tpu.observability.fleet import (
+    FLEET_RECORD_SCHEMA,
+    FleetConfig,
+    FleetObserver,
+    FleetWatchdog,
+    RECOVERY_SCHEMA,
+    align_train_series,
+    fleet_summary,
+    read_fleet,
+)
 from tensor2robot_tpu.observability.forensics import (
     FORENSICS_DIRNAME,
     attribute_goodput,
     build_report,
     read_reports,
+    split_collective_wait,
     write_report,
 )
 from tensor2robot_tpu.observability.goodput import (
@@ -71,6 +95,7 @@ from tensor2robot_tpu.observability.pipeline_xray import (
     attribute_stages,
 )
 from tensor2robot_tpu.observability.signals import (
+    host_identity,
     install_jax_listeners,
     sample_memory,
     uninstall_jax_listeners,
@@ -102,6 +127,7 @@ from tensor2robot_tpu.observability.telemetry_file import (
     HEARTBEAT_FILENAME,
     TELEMETRY_FILENAME,
     TelemetryLogger,
+    discover_hosts,
     read_heartbeat,
     read_telemetry,
 )
@@ -112,7 +138,11 @@ __all__ = [
     'Counter',
     'DEFAULT_LATENCY_BUCKETS_MS',
     'DEFAULT_SECONDS_BUCKETS',
+    'FLEET_RECORD_SCHEMA',
     'FORENSICS_DIRNAME',
+    'FleetConfig',
+    'FleetObserver',
+    'FleetWatchdog',
     'Gauge',
     'GOODPUT_CATEGORIES',
     'GoodputTracker',
@@ -120,6 +150,7 @@ __all__ = [
     'Histogram',
     'PIPELINE_RECORD_SCHEMA',
     'PipelineXray',
+    'RECOVERY_SCHEMA',
     'SLO_LATENCY_BUCKETS_MS',
     'StageMeter',
     'TELEMETRY_FILENAME',
@@ -128,12 +159,17 @@ __all__ = [
     'Watchdog',
     'WatchdogConfig',
     'XrayConfig',
+    'align_train_series',
     'attribute_goodput',
     'attribute_stages',
     'build_report',
+    'discover_hosts',
     'exponential_buckets',
+    'fleet_summary',
     'get_registry',
+    'host_identity',
     'install_jax_listeners',
+    'read_fleet',
     'read_heartbeat',
     'read_reports',
     'read_telemetry',
@@ -142,6 +178,7 @@ __all__ = [
     'set_trace_active',
     'snapshot_delta',
     'span',
+    'split_collective_wait',
     'trace_active',
     'uninstall_jax_listeners',
     'write_report',

@@ -276,7 +276,8 @@ class AutoProfiler:
         counters_delta=counters_delta,
         registry=self.registry,
         tuned_config=context.get('tuned_config'),
-        pipeline=self._start_pipeline)
+        pipeline=self._start_pipeline,
+        host=context.get('host'))
     path = forensics.write_report(self.model_dir, step, report)
     self.last_report_path = path
     _log('Forensics report: %s (top op: %s)', path,

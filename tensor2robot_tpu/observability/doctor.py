@@ -30,6 +30,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from tensor2robot_tpu.observability import fleet as fleet_lib
 from tensor2robot_tpu.observability import forensics as forensics_lib
 from tensor2robot_tpu.observability import telemetry_file
 from tensor2robot_tpu.observability import watchdog as watchdog_lib
@@ -89,8 +90,18 @@ def diagnose(model_dir: str,
     now = time.time()  # wall-clock: compared to heartbeat timestamps
   findings: List[Dict[str, object]] = []
 
+  # Primary lifecycle stream: the lowest-index host per discover_hosts,
+  # which applies the indexed-wins rule — in a model_dir holding BOTH a
+  # leftover single-process telemetry.jsonl and a fleet's
+  # telemetry.0.jsonl, the fleet's stream is the live one, and judging
+  # run_ended from the old run would suppress live fleet CRITICALs.
   telemetry_path = os.path.join(model_dir,
                                 telemetry_file.TELEMETRY_FILENAME)
+  host_files = telemetry_file.discover_hosts(model_dir)
+  for host in sorted(host_files):
+    if host_files[host].get('telemetry'):
+      telemetry_path = host_files[host]['telemetry']
+      break
   records: List[Dict[str, object]] = []
   if not os.path.exists(telemetry_path) or \
       os.path.getsize(telemetry_path) == 0:
@@ -104,7 +115,16 @@ def diagnose(model_dir: str,
       findings.append(_finding(
           WARNING, 'telemetry.jsonl is corrupt mid-file: {}'.format(e)))
 
+  # Whole-run staleness judges the FRESHEST heartbeat across hosts: the
+  # run is alive if any host is; one host gone quiet while others beat
+  # is the fleet section's host_dead verdict, not a wedged run.
   beat = telemetry_file.read_heartbeat(model_dir)
+  for host in sorted(host_files):
+    candidate = telemetry_file.read_heartbeat(model_dir,
+                                              process_index=host)
+    if candidate and (beat is None or
+                      candidate.get('time', 0) > beat.get('time', 0)):
+      beat = candidate
   # 'serving_stop' counts as an orderly end: a PolicyServer that closed
   # cleanly stops heartbeating by design, which is not a wedged process.
   run_ended = bool(records) and records[-1].get('kind') in (
@@ -272,6 +292,103 @@ def diagnose(model_dir: str,
               latest.get('p99_ms', 0.0), latest.get('slo_ms', 0.0),
               latest.get('batch_fill', 0.0),
               latest.get('params_version', 0))))
+
+  # Fleet section (ISSUE 9): federated per-host view. A host whose
+  # heartbeat is stale while others advance, or a straggler the fleet
+  # has not recovered from, halts/gates the whole mesh: CRITICAL while
+  # the run is live. Everything is recomputed from the per-host files —
+  # doctor must name the host without a live process anywhere.
+  try:
+    # Single-host dirs skip the federation pass: fleet_summary would
+    # re-read every rotated generation this function already parsed,
+    # doubling doctor's I/O for nothing (the only fleet-relevant facts
+    # of a one-host dir — recovery records — are in ``records``).
+    fsum = None
+    if len(host_files) > 1:
+      fsum = fleet_lib.fleet_summary(model_dir, now=now,
+                                     stale_secs=heartbeat_stale_secs)
+  except Exception as e:  # noqa: BLE001 — one torn stream, not a crash
+    fsum = None
+    findings.append(_finding(
+        WARNING, 'fleet summary failed: {}'.format(e)))
+  fleet_records = [r for r in records if r.get('kind') == 'fleet']
+  if fsum is not None and (fsum['host_count'] > 1 or fsum['recoveries']):
+    if fsum['host_count'] > 1:
+      parts = ['fleet: {} hosts'.format(fsum['host_count'])]
+      if fsum.get('step_time_skew'):
+        parts.append('step-time skew {:.2f}x (gating host {})'.format(
+            fsum['step_time_skew'], fsum['gating_host']))
+      if fsum.get('fleet_min_goodput') is not None:
+        parts.append('fleet-min goodput {:.0%}'.format(
+            fsum['fleet_min_goodput']))
+      findings.append(_finding(
+          INFO, ', '.join(parts), host_count=fsum['host_count'],
+          step_time_skew=fsum.get('step_time_skew'),
+          gating_host=fsum.get('gating_host'),
+          fleet_min_goodput=fsum.get('fleet_min_goodput')))
+    for host in fsum['dead_hosts']:
+      entry = fsum['hosts'].get(str(host), {})
+      # WARNING (not INFO) after run end — same downgrade rule as the
+      # straggler verdict: a host that died during a now-ended run is
+      # still evidence worth surfacing, just not a live page.
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'fleet: host {} ({}) heartbeat is {:.0f}s stale while other '
+          'hosts advance — dead or partitioned{}'.format(
+              host, entry.get('hostname'),
+              entry.get('heartbeat_age_s') or 0.0,
+              '' if not run_ended else ' (run already ended)'),
+          kind='host_dead', host=host, hostname=entry.get('hostname'),
+          heartbeat_age_s=entry.get('heartbeat_age_s')))
+    straggler_indices = [i for i, r in enumerate(records)
+                         if r.get('kind') == 'anomaly'
+                         and r.get('anomaly') == watchdog_lib.STRAGGLER]
+    if straggler_indices:
+      last_index = straggler_indices[-1]
+      last_straggler = records[last_index]
+      host = (last_straggler.get('detail') or {}).get('host')
+      # Recovery check (same shape as pipeline_stall): a LATER fleet
+      # window without a straggler means the skew passed — history,
+      # not a live page.
+      recovered = any(
+          r.get('kind') == 'fleet'
+          and watchdog_lib.STRAGGLER not in (r.get('anomalies') or [])
+          for r in records[last_index + 1:])
+      findings.append(_finding(
+          WARNING if (run_ended or recovered) else CRITICAL,
+          'fleet: host {} straggled {} window(s), last at step {}{} '
+          '({:.1f}x the fleet median)'.format(
+              host, len(straggler_indices), last_straggler.get('step'),
+              ' — recovered since' if recovered else '',
+              (last_straggler.get('detail') or {}).get('ratio') or 0.0),
+          kind='straggler', host=host, count=len(straggler_indices),
+          recovered=recovered))
+    elif fleet_records:
+      latest = fleet_records[-1]
+      findings.append(_finding(
+          INFO, 'fleet@{}: no straggler; gating host {} at skew '
+          '{}'.format(
+              latest.get('step'), latest.get('gating_host'),
+              'n/a' if latest.get('step_time_skew') is None
+              else '{:.2f}x'.format(latest['step_time_skew']))))
+    for warning in fsum.get('warnings') or []:
+      findings.append(_finding(WARNING, 'fleet: ' + warning))
+  recoveries = (fsum['recoveries'] if fsum is not None else
+                [r for r in records if r.get('kind') == 'recovery'])
+  for recovery in recoveries:
+    findings.append(_finding(
+        INFO, 'recovered from preemption at step {} in {:.1f}s '
+        '(save {:.1f}s, down {:.1f}s, restore {:.1f}s, first step '
+        '{:.1f}s)'.format(
+            recovery.get('preempted_step'),
+            recovery.get('preemption_recovery_seconds') or 0.0,
+            (recovery.get('phases') or {}).get('emergency_save_s', 0.0),
+            (recovery.get('phases') or {}).get('downtime_s', 0.0),
+            (recovery.get('phases') or {}).get('restore_s', 0.0),
+            (recovery.get('phases') or {}).get('first_step_s', 0.0)),
+        kind='recovery',
+        preemption_recovery_seconds=recovery.get(
+            'preemption_recovery_seconds')))
 
   # Watchdog anomaly records written in-process.
   anomalies = [r for r in records if r.get('kind') == 'anomaly']

@@ -1,0 +1,659 @@
+"""Fleet observatory: cross-host telemetry federation + attribution.
+
+Every observability layer through PR 6 is single-process by
+construction: the registry is process-wide, the watchdog reads one
+process's gauges, and ``telemetry.jsonl`` names no host. At DCN x ICI
+scale the dominant failure modes are exactly the cross-host ones —
+per-host skew (one slow host gates every all-reduce, so a straggler's
+wait is everyone's wait) and silently dead hosts (Scalable Training
+with pjit on TPUv4, arXiv:2204.06514). This module is the fleet-level
+lens over the per-host streams ``telemetry_file`` now emits:
+
+  * **Federation** (`read_fleet` / `align_train_series` /
+    `fleet_summary`) — merge ``telemetry.<i>.jsonl`` streams into one
+    fleet view: per-host step-time/goodput series aligned by step,
+    fleet goodput as the MIN across hosts (the gated quantity), skew,
+    and the gating host. Torn or partial per-host files degrade to
+    per-host warnings — one corrupt stream must not blind the fleet
+    view of the others.
+  * **FleetWatchdog** — the fleet analogue of `watchdog.Watchdog`:
+    ``straggler`` fires when one host's step time reaches
+    ``straggler_ratio`` (2x) times the rolling fleet-median baseline
+    (anomalous windows never fold into the baseline, so a sustained
+    straggler cannot normalize itself); ``host_dead`` fires when one
+    host's heartbeat goes stale while at least one other host is still
+    advancing (latched per host — a dead host is reported once, and
+    re-armed only if it comes back). Both count into the same
+    ``watchdog/anomalies`` family and flow through the same
+    anomaly -> budgeted-capture -> forensics loop.
+  * **FleetObserver** — the live in-trainer side: at the log cadence,
+    host 0 (or any host asked to observe) reads every host's
+    heartbeat file — heartbeats now carry ``step_time_s`` /
+    ``examples_per_sec`` / ``productive_fraction``, so the whole fleet
+    observation costs N tiny atomic-file reads, not N telemetry
+    re-parses — and feeds the FleetWatchdog. Each window yields a
+    ``t2r.fleet.v1`` telemetry record (per-host table, skew, gating
+    host, fleet-min goodput).
+  * **Recovery timeline** (``t2r.recovery.v1``) — the preemption ->
+    emergency save -> mesh rebuild -> resume path, measured per phase.
+    The preempting process writes an atomic recovery MARKER next to its
+    checkpoint (wall-clock stamped: the resuming process is a different
+    process); the resuming trainer consumes it and emits one
+    ``recovery`` record with ``phases`` and the headline
+    ``preemption_recovery_seconds`` — ROADMAP item 4's elastic-recovery
+    metric, measured before the elastic machinery itself exists.
+
+Everything here is jax-free (the ``bin/t2r_telemetry`` / doctor
+contract): host identity comes in as a plain dict
+(``signals.host_identity()`` on the trainer side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tensor2robot_tpu.observability import registry as registry_lib
+from tensor2robot_tpu.observability import telemetry_file
+from tensor2robot_tpu.observability.watchdog import (
+    ANOMALY_COUNTER,
+    Anomaly,
+    HOST_DEAD,
+    STRAGGLER,
+)
+
+__all__ = ['FLEET_RECORD_SCHEMA', 'RECOVERY_SCHEMA', 'FleetConfig',
+           'FleetWatchdog', 'FleetObserver', 'read_fleet',
+           'align_train_series', 'fleet_summary', 'dead_hosts',
+           'recovery_marker_path', 'write_recovery_marker',
+           'consume_recovery_marker', 'build_recovery_record',
+           'RECOVERY_GAUGE']
+
+FLEET_RECORD_SCHEMA = 't2r.fleet.v1'
+RECOVERY_SCHEMA = 't2r.recovery.v1'
+
+RECOVERY_GAUGE = 'reliability/preemption_recovery_seconds'
+
+_RECOVERY_MARKER = 'recovery_pending{}.json'
+
+
+class FleetConfig:
+  """Fleet detection thresholds.
+
+  ``straggler_ratio`` is deliberately 2x (not the watchdog's 1.8x):
+  cross-host skew of a few percent is normal DCN weather; a straggler
+  is a host that doubles everyone's step.
+  """
+
+  def __init__(self,
+               straggler_ratio: float = 2.0,
+               min_baseline_windows: int = 3,
+               baseline_windows: int = 16,
+               heartbeat_stale_secs: float = 300.0):
+    if straggler_ratio <= 1.0:
+      raise ValueError('straggler_ratio must exceed 1.0; got {}.'.format(
+          straggler_ratio))
+    self.straggler_ratio = float(straggler_ratio)
+    self.min_baseline_windows = int(min_baseline_windows)
+    self.baseline_windows = int(baseline_windows)
+    self.heartbeat_stale_secs = float(heartbeat_stale_secs)
+
+
+class FleetWatchdog:
+  """Rolling-baseline straggler + dead-host detection over one fleet."""
+
+  def __init__(self, config: Optional[FleetConfig] = None,
+               registry: Optional[registry_lib.TelemetryRegistry] = None):
+    self.config = config or FleetConfig()
+    self._registry = registry
+    self._medians: List[float] = []  # healthy fleet medians, rolling
+    self._windows_seen = 0  # warm-up gate (fleet windows observed)
+    self._dead: set = set()  # latched host_dead hosts
+
+  @property
+  def registry(self) -> registry_lib.TelemetryRegistry:
+    return self._registry or registry_lib.get_registry()
+
+  def _count(self, anomalies: List[Anomaly]) -> List[Anomaly]:
+    if anomalies:
+      family = self.registry.counter_family(ANOMALY_COUNTER, ('kind',))
+      for anomaly in anomalies:
+        family.series(anomaly.kind).inc()
+    return anomalies
+
+  def observe(self, step: int, host_step_times: Dict[int, float]
+              ) -> List[Anomaly]:
+    """One log-cadence pass over {host: window-mean seconds/step}.
+
+    A ``straggler`` fires for a host whose step time reaches
+    ``straggler_ratio`` x BOTH references:
+
+      * the median of its PEERS' times this window — which is what
+        makes a straggler a straggler (it lags the fleet, not some
+        absolute bar): a host slow from its very first window is
+        caught (no healthy history needed), and a fleet-WIDE slowdown
+        — every host slow together — fires nothing here (that is the
+        per-host watchdog's step_time_regression, not skew);
+      * the rolling median of HEALTHY fleet medians, when armed —
+        hysteresis against one noisy window moving both numbers.
+
+    Like the watchdog's step-time regression, a sustained straggler
+    keeps firing (the capture budget, not a latch, bounds the
+    response) and anomalous windows never fold into the baseline.
+    Needs >= 2 hosts reporting and ``min_baseline_windows`` observed
+    fleet windows before anything can fire (startup jitter damping).
+    """
+    times = {int(host): float(t) for host, t in host_step_times.items()
+             if t is not None and float(t) > 0.0}
+    if len(times) < 2:
+      return []
+    self._windows_seen += 1
+    window_median = statistics.median(times.values())
+    baseline = (statistics.median(self._medians)
+                if len(self._medians) >= self.config.min_baseline_windows
+                else None)
+    anomalies: List[Anomaly] = []
+    ratio = self.config.straggler_ratio
+    warmed_up = self._windows_seen > self.config.min_baseline_windows
+    skewed = False  # any host peer-skewed (vetoes folding, warm-up too)
+    for host, step_time_s in sorted(times.items()):
+      peer_median = statistics.median(
+          [t for h, t in times.items() if h != host])
+      if peer_median <= 0.0 or step_time_s < ratio * peer_median:
+        continue
+      skewed = True
+      if not warmed_up:
+        continue  # startup jitter damping: veto the fold, fire later
+      if baseline is not None and baseline > 0.0 and \
+          step_time_s < ratio * baseline:
+        continue
+      reference = baseline if baseline is not None else peer_median
+      anomalies.append(Anomaly(
+          STRAGGLER, step,
+          'host {} step time {:.1f} ms/step is {:.1f}x the fleet '
+          'median {:.1f} ms/step — its collectives gate every other '
+          'host'.format(host, step_time_s * 1e3,
+                        step_time_s / reference, reference * 1e3),
+          {'host': host, 'step_time_s': step_time_s,
+           'fleet_median_s': reference,
+           'peer_median_s': peer_median,
+           'ratio': step_time_s / reference,
+           'host_step_times': {str(h): t
+                               for h, t in sorted(times.items())}}))
+    if not anomalies and not skewed:
+      # A peer-skewed window never folds — during warm-up either: a
+      # host slow from boot would otherwise poison the baseline with
+      # pre-skewed medians and read as normal forever.
+      self._medians.append(window_median)
+      if len(self._medians) > self.config.baseline_windows:
+        self._medians.pop(0)
+    return self._count(anomalies)
+
+  def check_heartbeats(self, heartbeats: Dict[int, Optional[Dict]],
+                       now: float,
+                       live_hosts: Tuple[int, ...] = ()) -> List[Anomaly]:
+    """``host_dead``: one host's heartbeat stale while others advance.
+
+    ``now`` must come from the same wall clock as the heartbeat
+    ``time`` fields (they cross process boundaries — same caveat as
+    ``Watchdog.check_heartbeat``). ``live_hosts`` names hosts known
+    fresh without a file read (the observing host itself). A host with
+    NO heartbeat file yet is ignored (fleet startup is staggered); ALL
+    hosts stale is the whole-run ``heartbeat_stale`` case the existing
+    watchdog owns, not a fleet verdict. Latched per host: a dead host
+    fires once, and re-arms only after its heartbeat comes back fresh.
+    """
+    ages: Dict[int, float] = {}
+    for host, beat in heartbeats.items():
+      if beat is None:
+        continue
+      ages[int(host)] = float(now) - float(beat.get('time', 0.0))
+    for host in live_hosts:
+      ages[int(host)] = 0.0
+    stale_secs = self.config.heartbeat_stale_secs
+    fresh = [host for host, age in ages.items() if age <= stale_secs]
+    stale = [host for host, age in ages.items() if age > stale_secs]
+    anomalies: List[Anomaly] = []
+    if fresh:
+      for host in sorted(stale):
+        if host in self._dead:
+          continue
+        self._dead.add(host)
+        beat = heartbeats.get(host) or {}
+        step = beat.get('step')
+        anomalies.append(Anomaly(
+            HOST_DEAD, -1 if step is None else int(step),
+            'host {} heartbeat is {:.0f}s old (threshold {:.0f}s) while '
+            'host {} still advances: process dead or partitioned'.format(
+                host, ages[host], stale_secs, min(fresh)),
+            {'host': host, 'age_seconds': ages[host],
+             'pid': beat.get('pid'), 'hostname': beat.get('hostname'),
+             'fresh_hosts': sorted(fresh)}))
+    for host in fresh:
+      self._dead.discard(host)  # re-arm: the host came back
+    return self._count(anomalies)
+
+
+class FleetObserver:
+  """Live fleet observation for one trainer process (the log cadence).
+
+  Reads every host's heartbeat file under the shared model_dir —
+  heartbeats carry the window stats the trainer stamps into them — and
+  runs the FleetWatchdog over the result. The observing host's own
+  window numbers come from the caller (its heartbeat for this window
+  has not been written yet when ``observe`` runs).
+  """
+
+  def __init__(self, model_dir: str, identity: Dict[str, object],
+               config: Optional[FleetConfig] = None,
+               registry: Optional[registry_lib.TelemetryRegistry] = None):
+    self.model_dir = model_dir
+    self.identity = dict(identity or {})
+    self.config = config or FleetConfig()
+    self._watchdog = FleetWatchdog(self.config, registry=registry)
+    self.last_record: Optional[Dict[str, object]] = None
+
+  @property
+  def own_host(self) -> int:
+    return int(self.identity.get('process_index') or 0)
+
+  def observe(self, step: int,
+              step_time_s: Optional[float] = None,
+              examples_per_sec: Optional[float] = None,
+              productive_fraction: Optional[float] = None,
+              now: Optional[float] = None
+              ) -> Tuple[Optional[Dict[str, object]], List[Anomaly]]:
+    """(t2r.fleet.v1 record payload or None, fired anomalies).
+
+    Returns ``(None, [])`` while this model_dir holds only one host's
+    stream — a single-process run must not grow fleet records.
+    """
+    if now is None:
+      now = time.time()  # wall-clock: compared to heartbeat timestamps
+    own = self.own_host
+    hosts = telemetry_file.discover_hosts(self.model_dir)
+    beats: Dict[int, Optional[Dict[str, object]]] = {}
+    for host, files in hosts.items():
+      if host == own:
+        continue
+      beats[host] = _read_heartbeat_path(files.get('heartbeat'))
+    table: Dict[int, Dict[str, object]] = {own: {
+        'step': int(step),
+        'step_time_s': step_time_s,
+        'examples_per_sec': examples_per_sec,
+        'productive': productive_fraction,
+        'heartbeat_age_s': 0.0,
+        'hostname': self.identity.get('hostname'),
+    }}
+    for host, beat in beats.items():
+      if beat is None:
+        continue
+      table[host] = {
+          'step': beat.get('step'),
+          'step_time_s': beat.get('step_time_s'),
+          'examples_per_sec': beat.get('examples_per_sec'),
+          'productive': beat.get('productive_fraction'),
+          'heartbeat_age_s': float(now) - float(beat.get('time', 0.0)),
+          'hostname': beat.get('hostname'),
+      }
+    if len(table) < 2:
+      return None, []
+    anomalies = self._watchdog.check_heartbeats(
+        beats, now, live_hosts=(own,))
+    # Stragglers are judged over hosts with a FRESH window: a dead
+    # host's frozen step_time must not drag the fleet median.
+    stale_secs = self.config.heartbeat_stale_secs
+    times = {host: entry.get('step_time_s')
+             for host, entry in table.items()
+             if entry.get('step_time_s')
+             and float(entry.get('heartbeat_age_s', 0.0)) <= stale_secs}
+    anomalies.extend(self._watchdog.observe(step, times))
+    record = _fleet_record(table, anomalies)
+    self.last_record = record
+    return record, anomalies
+
+
+def _fleet_record(table: Dict[int, Dict[str, object]],
+                  anomalies: List[Anomaly]) -> Dict[str, object]:
+  times = {host: float(entry['step_time_s']) for host, entry in table.items()
+           if entry.get('step_time_s')}
+  productives = [float(entry['productive']) for entry in table.values()
+                 if entry.get('productive') is not None]
+  median = statistics.median(times.values()) if times else None
+  gating_host = max(times, key=times.get) if times else None
+  return {
+      'schema': FLEET_RECORD_SCHEMA,
+      'hosts': {str(host): entry for host, entry in sorted(table.items())},
+      'host_count': len(table),
+      'median_step_time_s': median,
+      # Skew: the gating host's step time over the fleet median — 1.0
+      # is a perfectly even fleet; the quantity straggler thresholds on.
+      'step_time_skew': (times[gating_host] / median
+                         if times and median else None),
+      'gating_host': gating_host,
+      # Min across hosts: a straggler's wait is everyone's wait, so the
+      # fleet's productive fraction is its weakest member's.
+      'fleet_min_goodput': min(productives) if productives else None,
+      'anomalies': [anomaly.kind for anomaly in anomalies],
+  }
+
+
+def _read_heartbeat_path(path: Optional[str]
+                         ) -> Optional[Dict[str, object]]:
+  if not path or not os.path.exists(path):
+    return None
+  try:
+    with open(path, encoding='utf-8') as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return None  # mid-replace race: treat as absent this window
+
+
+# -- offline federation ------------------------------------------------------
+
+
+def _read_host_tolerant(path: str, warnings: List[str], host: int
+                        ) -> List[Dict[str, object]]:
+  """One host's records, salvaging around interior corruption.
+
+  ``read_telemetry`` raises on malformed interior lines — right for a
+  single-stream tool, wrong for a fleet merge where one host's torn
+  file must not blind the view of the others. Bad lines are skipped
+  and counted into ``warnings`` instead.
+  """
+  records: List[Dict[str, object]] = []
+  bad = 0
+  for generation in telemetry_file.rotated_paths(path):
+    if not os.path.exists(generation):
+      continue
+    try:
+      with open(generation, encoding='utf-8') as f:
+        lines = f.read().splitlines()
+    except OSError as e:
+      warnings.append('host {}: unreadable {}: {}'.format(
+          host, generation, e))
+      continue
+    for index, line in enumerate(lines):
+      if not line.strip():
+        continue
+      try:
+        records.append(json.loads(line))
+      except ValueError:
+        if index == len(lines) - 1:
+          continue  # torn tail from a killed writer: expected
+        bad += 1
+  if bad:
+    warnings.append('host {}: skipped {} malformed interior line(s) in '
+                    '{}'.format(host, bad, path))
+  return records
+
+
+def read_fleet(model_dir: str) -> Dict[str, object]:
+  """Merged per-host view of one model_dir.
+
+  ``{'hosts': {index: [records]}, 'heartbeats': {index: beat|None},
+  'warnings': [...]}``. Hosts with a heartbeat but no telemetry (or
+  vice versa) still appear — a partially-written host is evidence, not
+  an error.
+  """
+  warnings: List[str] = []
+  hosts: Dict[int, List[Dict[str, object]]] = {}
+  heartbeats: Dict[int, Optional[Dict[str, object]]] = {}
+  for host, files in sorted(telemetry_file.discover_hosts(model_dir).items()):
+    heartbeats[host] = _read_heartbeat_path(files.get('heartbeat'))
+    if files.get('telemetry'):
+      records = _read_host_tolerant(files['telemetry'], warnings, host)
+      for record in records:
+        record.setdefault('process_index', host)
+      hosts[host] = records
+    else:
+      warnings.append('host {}: heartbeat but no telemetry stream'.format(
+          host))
+      hosts[host] = []
+  return {'hosts': hosts, 'heartbeats': heartbeats, 'warnings': warnings}
+
+
+def merged_records(fleet: Dict[str, object]) -> List[Dict[str, object]]:
+  """All hosts' records interleaved by wall-clock record time."""
+  out: List[Dict[str, object]] = []
+  for records in fleet['hosts'].values():
+    out.extend(records)
+  out.sort(key=lambda record: record.get('time', 0.0))
+  return out
+
+
+def align_train_series(fleet: Dict[str, object]) -> Dict[str, object]:
+  """Per-host train series aligned by step.
+
+  ``{'hosts': {index: {step: {'step_time_s', 'examples_per_sec',
+  'productive'}}}, 'steps': [aligned steps], 'fleet_goodput':
+  {step: min-across-hosts productive}}``. Aligned steps are those every
+  host reported — the only windows where min-across-hosts is a fleet
+  fact rather than a race.
+  """
+  series: Dict[int, Dict[int, Dict[str, object]]] = {}
+  for host, records in fleet['hosts'].items():
+    per_step: Dict[int, Dict[str, object]] = {}
+    for record in records:
+      if record.get('kind') != 'train' or record.get('step') is None:
+        continue
+      goodput = record.get('goodput') or {}
+      per_step[int(record['step'])] = {
+          'step_time_s': record.get('step_time_s'),
+          'examples_per_sec': record.get('examples_per_sec'),
+          'productive': goodput.get('productive'),
+      }
+    if per_step:
+      series[host] = per_step
+  steps: List[int] = []
+  if series:
+    common = set.intersection(*(set(s) for s in series.values()))
+    steps = sorted(common)
+  fleet_goodput: Dict[int, float] = {}
+  for step in steps:
+    productives = [series[host][step].get('productive')
+                   for host in series]
+    productives = [p for p in productives if p is not None]
+    if productives:
+      fleet_goodput[step] = min(productives)
+  return {'hosts': series, 'steps': steps, 'fleet_goodput': fleet_goodput}
+
+
+def dead_hosts(heartbeats: Dict[int, Optional[Dict[str, object]]],
+               now: float, stale_secs: float = 300.0) -> List[int]:
+  """Hosts whose heartbeat is stale while at least one other is fresh.
+
+  Read-only by contract: routed through a THROWAWAY registry so a
+  summary/doctor pass never inflates the live ``watchdog/anomalies``
+  counters — counting is the live observer's side effect, not a
+  digest's.
+  """
+  probe = FleetWatchdog(FleetConfig(heartbeat_stale_secs=stale_secs),
+                        registry=registry_lib.TelemetryRegistry())
+  return sorted(anomaly.detail['host']
+                for anomaly in probe.check_heartbeats(heartbeats, now)
+                if anomaly.kind == HOST_DEAD)
+
+
+def fleet_summary(model_dir: str, now: Optional[float] = None,
+                  stale_secs: float = 300.0) -> Dict[str, object]:
+  """The offline fleet digest doctor / ``t2r_telemetry fleet`` render.
+
+  Independent of the live FleetObserver: recomputed from the merged
+  per-host streams + heartbeat files alone, so it works on any box that
+  sees the filesystem.
+  """
+  if now is None:
+    now = time.time()  # wall-clock: heartbeat ages
+  fleet = read_fleet(model_dir)
+  aligned = align_train_series(fleet)
+  merged = merged_records(fleet)
+  hosts: Dict[str, Dict[str, object]] = {}
+  for host, records in sorted(fleet['hosts'].items()):
+    beat = fleet['heartbeats'].get(host)
+    trains = [r for r in records if r.get('kind') == 'train']
+    last = trains[-1] if trains else {}
+    goodput = last.get('goodput') or {}
+    identity = next(
+        (r for r in records if r.get('device_kind') is not None), {})
+    hosts[str(host)] = {
+        'hostname': (beat or {}).get('hostname') or last.get('hostname'),
+        'device_kind': identity.get('device_kind'),
+        'last_step': last.get('step'),
+        'step_time_s': last.get('step_time_s'),
+        'examples_per_sec': last.get('examples_per_sec'),
+        'productive': goodput.get('productive'),
+        'heartbeat_age_s': (float(now) - float(beat.get('time', 0.0))
+                            if beat else None),
+        'records': len(records),
+    }
+  last_aligned = aligned['steps'][-1] if aligned['steps'] else None
+  skew = None
+  gating_host = None
+  if last_aligned is not None:
+    times = {host: series[last_aligned].get('step_time_s')
+             for host, series in aligned['hosts'].items()
+             if series[last_aligned].get('step_time_s')}
+    if times:
+      gating_host = max(times, key=times.get)
+      median = statistics.median(times.values())
+      if median:
+        skew = times[gating_host] / median
+  anomaly_counts: Dict[str, int] = {}
+  for record in merged:
+    if record.get('kind') == 'anomaly':
+      kind = str(record.get('anomaly'))
+      anomaly_counts[kind] = anomaly_counts.get(kind, 0) + 1
+  recoveries = [r for r in merged if r.get('kind') == 'recovery']
+  return {
+      'host_count': len(fleet['hosts']),
+      'hosts': hosts,
+      'aligned_steps': len(aligned['steps']),
+      'last_aligned_step': last_aligned,
+      'step_time_skew': skew,
+      'gating_host': gating_host,
+      'fleet_min_goodput': (aligned['fleet_goodput'].get(last_aligned)
+                            if last_aligned is not None else None),
+      'dead_hosts': dead_hosts(fleet['heartbeats'], now,
+                               stale_secs=stale_secs),
+      'anomaly_counts': anomaly_counts,
+      'recoveries': [{
+          'preempted_step': r.get('preempted_step'),
+          'resume_step': r.get('resume_step'),
+          'preemption_recovery_seconds':
+              r.get('preemption_recovery_seconds'),
+          'phases': r.get('phases'),
+          'process_index': r.get('process_index', 0),
+      } for r in recoveries],
+      'warnings': fleet['warnings'],
+  }
+
+
+# -- recovery timeline (t2r.recovery.v1) -------------------------------------
+
+
+def recovery_marker_path(model_dir: str,
+                         process_index: Optional[int] = None) -> str:
+  suffix = '' if not process_index else '.{}'.format(int(process_index))
+  return os.path.join(model_dir, _RECOVERY_MARKER.format(suffix))
+
+
+def write_recovery_marker(model_dir: str, step: int, signum: int,
+                          save_seconds: float,
+                          process_index: Optional[int] = None) -> str:
+  """Atomically records "a preemption just happened here".
+
+  Written by the PREEMPTING process after its emergency save commits;
+  consumed by the RESUMING process (usually a different pid, possibly a
+  different host booting the same model_dir), which is why the stamp is
+  wall-clock. ``save_seconds`` is the emergency save's duration — the
+  first phase of the recovery timeline, measurable only on this side.
+  """
+  path = recovery_marker_path(model_dir, process_index)
+  marker = {
+      'time': time.time(),  # wall-clock: read by the resuming process
+      'step': int(step),
+      'signum': int(signum),
+      'save_seconds': float(save_seconds),
+      'process_index': int(process_index or 0),
+  }
+  tmp = path + '.tmp'
+  with open(tmp, 'w', encoding='utf-8') as f:
+    json.dump(marker, f)
+  os.replace(tmp, path)
+  return path
+
+
+def consume_recovery_marker(model_dir: str,
+                            process_index: Optional[int] = None
+                            ) -> Optional[Dict[str, object]]:
+  """Reads AND removes the pending-recovery marker (None when absent).
+
+  Removal is the idempotence guard: one preemption yields exactly one
+  recovery record, however many restarts follow.
+  """
+  path = recovery_marker_path(model_dir, process_index)
+  if not os.path.exists(path):
+    return None
+  try:
+    with open(path, encoding='utf-8') as f:
+      marker = json.load(f)
+  except (OSError, ValueError):
+    marker = None  # torn marker: drop it rather than crash the resume
+  try:
+    os.remove(path)
+  except OSError:
+    pass
+  return marker
+
+
+def build_recovery_record(marker: Dict[str, object],
+                          restore_seconds: float,
+                          first_step_seconds: float,
+                          resume_step: int,
+                          now: Optional[float] = None
+                          ) -> Dict[str, object]:
+  """The ``t2r.recovery.v1`` payload for one preemption->resume cycle.
+
+  Phases partition the timeline end to end:
+
+    * ``emergency_save_s`` — preemption detected -> checkpoint committed
+      (measured by the preempting process, carried via the marker);
+    * ``downtime_s``       — process death -> resuming trainer starts
+      restoring (scheduler wait + process boot; the remainder);
+    * ``restore_s``        — checkpoint restore + mesh/state rebuild;
+    * ``first_step_s``     — restore done -> first trained step lands.
+
+  ``preemption_recovery_seconds`` is their sum BY CONSTRUCTION: every
+  second between the preemption signal and the first productive step
+  afterwards. The marker-to-now span is wall-clock across two
+  processes (possibly two hosts), so under cross-host clock skew the
+  locally-measured monotonic durations (restore + first step) are the
+  floor — the span is clamped up to them rather than letting a
+  behind-running resume clock underreport the outage and break the
+  phases-sum-to-total invariant.
+  """
+  if now is None:
+    now = time.time()  # wall-clock: spans two processes
+  save_s = float(marker.get('save_seconds', 0.0))
+  since_marker = max(float(now) - float(marker.get('time', now)), 0.0)
+  measured = float(restore_seconds) + float(first_step_seconds)
+  span = max(since_marker, measured)
+  total = save_s + span
+  downtime = span - measured
+  return {
+      'schema': RECOVERY_SCHEMA,
+      'preempted_step': marker.get('step'),
+      'resume_step': int(resume_step),
+      'signum': marker.get('signum'),
+      'phases': {
+          'emergency_save_s': save_s,
+          'downtime_s': downtime,
+          'restore_s': float(restore_seconds),
+          'first_step_s': float(first_step_seconds),
+      },
+      'preemption_recovery_seconds': total,
+  }

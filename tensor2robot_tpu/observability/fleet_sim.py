@@ -1,0 +1,119 @@
+"""Simulated-host telemetry writer: the fleet test/fixture harness.
+
+The real two-process proof (``parallel/multihost.py`` under
+``jax.distributed``) cannot run on this container — the CPU backend
+does not implement multi-process computations — and the federation
+layer must be testable without it anyway (its contract is files, not
+collectives). This module is the harness that replaces it for fleet
+tests: a **simulated host** is a plain process (or in-process call)
+that emits exactly what a real trainer process emits — ``run_start``,
+per-window ``train`` records with goodput + ``step_time_s``, heartbeats
+carrying the window stats, ``run_end`` — through the SAME
+``TelemetryLogger`` + ``host_meta`` path, under the same shared
+model_dir. Two of these spawned as real subprocesses give the
+federation round-trip (concurrent writers, separate per-host files,
+merged fleet view) with none of jax.distributed's failure modes.
+
+Used by ``tests/test_fleet.py`` (subprocess federation round-trip),
+``bin/check_fleet_doctor`` (jax-free doctor fixtures), and the
+MULTICHIP dryrun's fleet phase (the simulated peer host). Jax-free by
+construction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict, Optional, Sequence
+
+from tensor2robot_tpu.observability.telemetry_file import TelemetryLogger
+
+__all__ = ['host_meta', 'write_host_run', 'main']
+
+
+def host_meta(process_index: int, process_count: int,
+              device_kind: str = 'sim-cpu',
+              hostname: Optional[str] = None) -> Dict[str, object]:
+  return {
+      'process_index': int(process_index),
+      'process_count': int(process_count),
+      'device_kind': device_kind,
+      'hostname': hostname or 'simhost{}'.format(int(process_index)),
+  }
+
+
+def write_host_run(model_dir: str,
+                   process_index: int,
+                   process_count: int,
+                   step_times_s: Sequence[float],
+                   steps_per_window: int = 100,
+                   batch_size: int = 32,
+                   productive: float = 0.9,
+                   end: str = 'run_end',
+                   heartbeat_time: Optional[float] = None,
+                   sleep_per_window_s: float = 0.0,
+                   device_kind: str = 'sim-cpu') -> TelemetryLogger:
+  """Emits one simulated host's full stream under ``model_dir``.
+
+  One ``train`` record + heartbeat per entry of ``step_times_s`` (the
+  window's mean step time), at steps ``steps_per_window, 2x, ...`` —
+  the same cadence/step alignment a real fleet shares, so two simulated
+  hosts federate on identical steps. ``end`` is ``'run_end'``,
+  ``'preempted'``, or ``'live'`` (no terminal record: the run looks
+  in-flight, which is what dead-host/straggler CRITICAL gating needs).
+  ``heartbeat_time`` overrides the final heartbeat's wall-clock stamp
+  (a frozen/stale heartbeat is how a dead host looks from outside).
+  ``sleep_per_window_s`` spaces the records in real time so concurrent
+  writers interleave by timestamp.
+  """
+  meta = host_meta(process_index, process_count, device_kind=device_kind)
+  logger = TelemetryLogger(model_dir, host_meta=meta)
+  logger.log('run_start', step=0, batch_size=batch_size,
+             max_train_steps=steps_per_window * len(step_times_s))
+  step = 0
+  for window, step_time_s in enumerate(step_times_s):
+    step = steps_per_window * (window + 1)
+    examples_per_sec = batch_size / max(step_time_s, 1e-9)
+    goodput = {'productive': productive, 'data': 1.0 - productive,
+               'checkpoint': 0.0, 'retry': 0.0}
+    logger.log('train', step=step, loss=0.5, step_time_s=step_time_s,
+               examples_per_sec=examples_per_sec, goodput=goodput,
+               gauges={}, counters={})
+    extra = {'step_time_s': step_time_s,
+             'examples_per_sec': examples_per_sec,
+             'productive_fraction': productive}
+    if heartbeat_time is not None and window == len(step_times_s) - 1:
+      extra['time'] = heartbeat_time
+    logger.heartbeat(step, **extra)
+    logger.flush()
+    if sleep_per_window_s > 0.0:
+      time.sleep(sleep_per_window_s)
+  if end != 'live':
+    logger.log(end, step=step, goodput={
+        'productive': productive, 'data': 1.0 - productive,
+        'checkpoint': 0.0, 'retry': 0.0})
+  logger.close()
+  return logger
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--model_dir', required=True)
+  parser.add_argument('--process_index', type=int, required=True)
+  parser.add_argument('--process_count', type=int, default=2)
+  parser.add_argument('--step_times', default='0.01,0.01,0.01,0.01',
+                      help='comma-separated window mean step times (s)')
+  parser.add_argument('--steps_per_window', type=int, default=100)
+  parser.add_argument('--end', default='run_end',
+                      choices=('run_end', 'preempted', 'live'))
+  parser.add_argument('--sleep_per_window_secs', type=float, default=0.0)
+  args = parser.parse_args(argv)
+  write_host_run(
+      args.model_dir, args.process_index, args.process_count,
+      [float(t) for t in args.step_times.split(',') if t],
+      steps_per_window=args.steps_per_window, end=args.end,
+      sleep_per_window_s=args.sleep_per_window_secs)
+
+
+if __name__ == '__main__':
+  main()

@@ -29,13 +29,14 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tensor2robot_tpu.observability import registry as registry_lib
 
 __all__ = ['FORENSICS_DIRNAME', 'REPORT_SCHEMA', 'build_report',
            'write_report', 'read_reports', 'find_latest_xplane',
-           'attribute_goodput']
+           'attribute_goodput', 'split_collective_wait']
 
 FORENSICS_DIRNAME = 'forensics'
 REPORT_SCHEMA = 't2r.forensics.v1'
@@ -68,7 +69,7 @@ def find_latest_xplane(model_dir: str,
 
 
 def _device_top_ops(xplane_path: str, n_steps: int, top_k: int):
-  """(top_ops, occupancy, overlap, warnings) from one capture.
+  """(top_ops, occupancy, overlap, warnings, families) from one capture.
 
   Prefers the TPU ``XLA Ops`` line (serial device stream). A capture
   with several TPU planes (multi-chip) is narrowed to the first plane —
@@ -147,7 +148,75 @@ def _device_top_ops(xplane_path: str, n_steps: int, top_k: int):
       }
   if not top_ops:
     warnings.append('capture held no attributable op events')
-  return top_ops, occupancy, overlap, warnings
+  return top_ops, occupancy, overlap, warnings, families
+
+
+_COLLECTIVE_TOKENS = ('all-reduce', 'all-gather', 'all-to-all',
+                      'collective-permute', 'reduce-scatter',
+                      'collective-broadcast')
+
+
+def _collective_kind(op_family: str) -> Optional[str]:
+  """The collective kind an op family name carries, or None for compute."""
+  for token in _COLLECTIVE_TOKENS:
+    if token in op_family:
+      return token
+  return None
+
+
+def split_collective_wait(families: List[Tuple[str, float]],
+                          hlo_collectives: Optional[List[Dict[str, object]]]
+                          = None) -> Dict[str, object]:
+  """Device time split: compute vs. time spent inside collectives.
+
+  ``families`` is the capture's full [(op family, ms/step)] table. A
+  collective op's device time is transfer PLUS the wait for every
+  other participant to arrive — which is exactly why this is the fleet
+  straggler's signature: on the straggling host the step is long in
+  COMPUTE, on every other host it is long in collective-wait. The
+  fraction here, read per host across a fleet's captures, names which
+  hosts waited and which one they waited for; ``gating_collective`` is
+  the collective family that burned the most device time.
+  ``hlo_collectives`` (``hlo_analysis.collective_ops``) attaches the
+  per-step payload bytes each named collective moves.
+  """
+  hlo_bytes: Dict[str, int] = {}
+  hlo_kind_bytes: Dict[str, int] = {}
+  for op in hlo_collectives or []:
+    family = '%' + _FAMILY_SUFFIX_RE.sub('', str(op.get('name', '')))
+    hlo_bytes[family] = hlo_bytes.get(family, 0) + int(op.get('bytes', 0))
+    kind = str(op.get('kind', ''))
+    hlo_kind_bytes[kind] = hlo_kind_bytes.get(kind, 0) + \
+        int(op.get('bytes', 0))
+  compute_ms = 0.0
+  collectives: List[Dict[str, object]] = []
+  for name, ms in families:
+    kind = _collective_kind(name)
+    if kind is None:
+      compute_ms += ms
+      continue
+    nbytes = hlo_bytes.get(name)
+    if nbytes is None:
+      # '-start' device events vs sync HLO names (or vice versa): fall
+      # back to the kind's total payload as the best available figure.
+      nbytes = hlo_kind_bytes.get(kind)
+    collectives.append({'name': name, 'kind': kind, 'ms_per_step': ms,
+                        'bytes': nbytes})
+  collective_ms = sum(c['ms_per_step'] for c in collectives)
+  total = compute_ms + collective_ms
+  collectives.sort(key=lambda c: -c['ms_per_step'])
+  for entry in collectives:
+    entry['fraction'] = (entry['ms_per_step'] / total) if total else 0.0
+  return {
+      'compute_ms_per_step': compute_ms,
+      'collective_ms_per_step': collective_ms,
+      'collective_wait_fraction': (collective_ms / total) if total else 0.0,
+      'collectives': collectives,
+      'gating_collective': collectives[0]['name'] if collectives else None,
+  }
+
+
+_FAMILY_SUFFIX_RE = re.compile(r'\.\d+$')
 
 
 def attribute_goodput(fractions: Dict[str, float],
@@ -215,7 +284,8 @@ def build_report(step: int,
                  counters_delta: Optional[Dict[str, float]] = None,
                  registry: Optional[registry_lib.TelemetryRegistry] = None,
                  tuned_config: Optional[str] = None,
-                 pipeline: Optional[Dict[str, object]] = None
+                 pipeline: Optional[Dict[str, object]] = None,
+                 host: Optional[Dict[str, object]] = None
                  ) -> Dict[str, object]:
   """Assembles the forensics report dict. Never raises: torn captures,
   missing HLO, or reader bugs each degrade to a ``warnings`` entry.
@@ -225,7 +295,10 @@ def build_report(step: int,
   attributable to the config that compiled the step it profiled.
   ``pipeline``: the latest ``t2r.pipeline.v1`` X-ray record (stage
   capacity table + gating-stage attribution), carried verbatim so a
-  data-path incident's report names the stage, not just the symptom."""
+  data-path incident's report names the stage, not just the symptom.
+  ``host``: this process's fleet identity (``signals.host_identity()``)
+  — with the ``collective_wait`` split below, a straggler capture names
+  WHICH host gated WHICH collective, not just that a step got slow."""
   registry = registry or registry_lib.get_registry()
   warnings: List[str] = []
   report: Dict[str, object] = {
@@ -235,11 +308,13 @@ def build_report(step: int,
       'trigger': dict(trigger or {}),
       'window': dict(window or {}),
       'xplane_path': xplane_path,
+      'host': dict(host) if host else None,
       'top_ops': [],
       'device_occupancy': None,
       'host_device_overlap': None,
       'collectives': {},
       'collective_bytes_total': 0,
+      'collective_wait': None,
       'goodput': dict(goodput_fractions or {}),
       'attribution': [],
       'counters_delta': dict(counters_delta or {}),
@@ -253,12 +328,13 @@ def build_report(step: int,
   except Exception as e:  # noqa: BLE001
     scalars = {}
     warnings.append('registry scalars unavailable: {}'.format(e))
+  families: List[Tuple[str, float]] = []
   if xplane_path is None:
     warnings.append('no xplane capture found for this window')
   else:
     try:
-      top_ops, occupancy, overlap, op_warnings = _device_top_ops(
-          xplane_path, max(n_steps, 1), DEFAULT_TOP_K)
+      top_ops, occupancy, overlap, op_warnings, families = \
+          _device_top_ops(xplane_path, max(n_steps, 1), DEFAULT_TOP_K)
       report['top_ops'] = top_ops
       report['device_occupancy'] = occupancy
       report['host_device_overlap'] = overlap
@@ -266,6 +342,7 @@ def build_report(step: int,
     except Exception as e:  # noqa: BLE001 — torn/truncated capture
       warnings.append('xplane analysis failed ({}: {}); raw capture kept '
                       'at {}'.format(type(e).__name__, e, xplane_path))
+  hlo_collectives = None
   if hlo_text_fn is not None:
     try:
       hlo_text = hlo_text_fn()
@@ -275,8 +352,15 @@ def build_report(step: int,
         report['collectives'] = stats
         report['collective_bytes_total'] = \
             hlo_analysis.total_collective_bytes(stats)
+        hlo_collectives = hlo_analysis.collective_ops(hlo_text)
     except Exception as e:  # noqa: BLE001 — HLO is best-effort evidence
       warnings.append('collective analysis failed: {}'.format(e))
+  if families:
+    try:
+      report['collective_wait'] = split_collective_wait(
+          families, hlo_collectives)
+    except Exception as e:  # noqa: BLE001
+      warnings.append('collective-wait split failed: {}'.format(e))
   try:
     report['attribution'] = attribute_goodput(
         report['goodput'], scalars)

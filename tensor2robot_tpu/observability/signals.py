@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import resource
+import socket
 from typing import Dict, Optional
 
 from tensor2robot_tpu.observability import registry as registry_lib
@@ -36,6 +37,7 @@ __all__ = [
     'CACHE_MISS_COUNTER', 'HOST_RSS_GAUGE', 'HOST_PEAK_RSS_GAUGE',
     'DEVICE_BYTES_GAUGE', 'DEVICE_PEAK_BYTES_GAUGE',
     'install_jax_listeners', 'uninstall_jax_listeners', 'sample_memory',
+    'host_identity',
 ]
 
 COMPILE_COUNTER = 'jax/compiles'
@@ -106,6 +108,36 @@ def uninstall_jax_listeners() -> None:
   no-op while disabled). Test hook."""
   global _enabled
   _enabled = False
+
+
+def host_identity() -> Dict[str, object]:
+  """This process's fleet identity: the ``host_meta`` dict every
+  per-host telemetry record is stamped with (ISSUE 9).
+
+  ``{'process_index', 'process_count', 'device_kind', 'hostname'}`` —
+  process coordinates from ``jax.distributed``'s view of the world,
+  device kind from the first local device. Degrades to the
+  single-process identity (``0 of 1``, ``device_kind='unknown'``) on
+  jax-free hosts so the doctor/fleet tooling can call it too.
+  """
+  identity: Dict[str, object] = {
+      'process_index': 0,
+      'process_count': 1,
+      'device_kind': 'unknown',
+      'hostname': socket.gethostname(),
+  }
+  try:
+    import jax
+
+    identity['process_index'] = int(jax.process_index())
+    identity['process_count'] = int(jax.process_count())
+    local = jax.local_devices()
+    if local:
+      identity['device_kind'] = str(
+          getattr(local[0], 'device_kind', 'unknown'))
+  except Exception:  # noqa: BLE001 — jax-free or uninitialized backend
+    pass
+  return identity
 
 
 def _host_rss_bytes() -> Optional[float]:

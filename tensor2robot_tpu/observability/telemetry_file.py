@@ -32,26 +32,76 @@ keep the full retained history.
 ``read_telemetry`` tolerates a torn final line (the writer may be killed
 mid-append) but raises on malformed interior lines — silent corruption
 of history is worse than a crash in a tool.
+
+**Fleet emission (ISSUE 9)**: a multi-process (multi-host) run shares one
+``model_dir``, and two processes appending to the same ``telemetry.jsonl``
+would interleave torn lines and race the rotation rename. Each process
+therefore writes its OWN stream — ``telemetry.<process_index>.jsonl`` +
+``heartbeat.<process_index>.json`` — named by ``host_meta`` (the
+``process_index``/``process_count``/``device_kind``/``hostname`` identity
+dict ``signals.host_identity()`` builds), and every record/heartbeat is
+stamped with that identity so a merged fleet view can attribute each line
+to its host. Single-process runs (``process_count`` absent or 1) keep the
+bare filenames, so nothing downstream of a one-host run changes.
+``discover_hosts``/``read_heartbeat(..., process_index=)`` are the
+jax-free reading half ``observability/fleet.py`` federates over.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import socket
 import threading
 import time
 from typing import Dict, List, Optional
 
 __all__ = ['TelemetryLogger', 'read_telemetry', 'read_heartbeat',
-           'rotated_paths', 'TELEMETRY_FILENAME', 'HEARTBEAT_FILENAME',
-           'DEFAULT_MAX_BYTES', 'DEFAULT_MAX_ROTATED']
+           'rotated_paths', 'discover_hosts', 'telemetry_filename',
+           'heartbeat_filename', 'TELEMETRY_FILENAME', 'HEARTBEAT_FILENAME',
+           'DEFAULT_MAX_BYTES', 'DEFAULT_MAX_ROTATED', 'HOST_META_KEYS']
 
 TELEMETRY_FILENAME = 'telemetry.jsonl'
 HEARTBEAT_FILENAME = 'heartbeat.json'
 
+# Identity fields stamped into every record/heartbeat of a host-scoped
+# stream (matching signals.host_identity()).
+HOST_META_KEYS = ('process_index', 'process_count', 'device_kind',
+                  'hostname')
+
 DEFAULT_MAX_BYTES = 256 * 2**20
 DEFAULT_MAX_ROTATED = 2
+
+_HOST_TELEMETRY_RE = re.compile(r'^telemetry\.(\d+)\.jsonl$')
+_HOST_HEARTBEAT_RE = re.compile(r'^heartbeat\.(\d+)\.json$')
+
+
+def _is_fleet_meta(host_meta: Optional[Dict[str, object]]) -> bool:
+  """Whether this identity names one host OF SEVERAL (indexed filenames)."""
+  if not host_meta:
+    return False
+  return int(host_meta.get('process_count') or 1) > 1 and \
+      host_meta.get('process_index') is not None
+
+
+def telemetry_filename(host_meta: Optional[Dict[str, object]] = None) -> str:
+  """Live telemetry filename for one host's stream.
+
+  ``telemetry.<process_index>.jsonl`` when the identity names one host of
+  a multi-process run; the historical bare name otherwise — a
+  single-process run must keep today's layout so nothing downstream
+  breaks.
+  """
+  if _is_fleet_meta(host_meta):
+    return 'telemetry.{}.jsonl'.format(int(host_meta['process_index']))
+  return TELEMETRY_FILENAME
+
+
+def heartbeat_filename(host_meta: Optional[Dict[str, object]] = None) -> str:
+  if _is_fleet_meta(host_meta):
+    return 'heartbeat.{}.json'.format(int(host_meta['process_index']))
+  return HEARTBEAT_FILENAME
 
 
 class TelemetryLogger:
@@ -66,19 +116,28 @@ class TelemetryLogger:
   an internal lock, so a PolicyServer's serve loop and its hot-swap
   poller (ISSUE 8 — the first multi-threaded writer) cannot interleave
   a record mid-line or race the rotation's close/reopen. Cross-PROCESS
-  writers still need separate files (each process tracks its own size).
+  writers each need their own files — which is exactly what ``host_meta``
+  provides: a multi-process identity routes this logger to
+  ``telemetry.<process_index>.jsonl`` / ``heartbeat.<process_index>.json``
+  and stamps every record/heartbeat with the identity fields
+  (``HOST_META_KEYS``), so N hosts sharing one model_dir never contend
+  for one file and every merged line names its writer.
   """
 
   def __init__(self, model_dir: str,
                max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
-               max_rotated: int = DEFAULT_MAX_ROTATED):
+               max_rotated: int = DEFAULT_MAX_ROTATED,
+               host_meta: Optional[Dict[str, object]] = None):
     os.makedirs(model_dir, exist_ok=True)
     self.model_dir = model_dir
     self.max_bytes = None if max_bytes is None else int(max_bytes)
     self.max_rotated = max(1, int(max_rotated))
     self._lock = threading.Lock()
-    self._path = os.path.join(model_dir, TELEMETRY_FILENAME)
-    self._heartbeat_path = os.path.join(model_dir, HEARTBEAT_FILENAME)
+    self.host_meta = {key: host_meta[key] for key in HOST_META_KEYS
+                      if key in host_meta} if host_meta else None
+    self._path = os.path.join(model_dir, telemetry_filename(host_meta))
+    self._heartbeat_path = os.path.join(model_dir,
+                                        heartbeat_filename(host_meta))
     self._file = open(self._path, 'a', encoding='utf-8')
     # Tracked size, NOT self._file.tell(): tell() on a text append
     # stream flushes the write buffer, which would turn every log()
@@ -113,6 +172,8 @@ class TelemetryLogger:
         'time': time.time(),  # wall-clock timestamp (cross-process record)
         'kind': kind,
         'step': None if step is None else int(step)}
+    if self.host_meta:
+      record.update(self.host_meta)
     record.update(payload)
     line = json.dumps(record) + '\n'
     encoded = len(line.encode('utf-8'))
@@ -130,6 +191,8 @@ class TelemetryLogger:
         'pid': os.getpid(),
         'hostname': socket.gethostname(),
     }
+    if self.host_meta:
+      beat.update(self.host_meta)
     beat.update(extra)
     tmp = self._heartbeat_path + '.tmp'
     with self._lock:  # two threads sharing one tmp path must serialize
@@ -203,10 +266,75 @@ def read_telemetry(path: str) -> List[Dict[str, object]]:
   return records
 
 
-def read_heartbeat(model_dir: str) -> Optional[Dict[str, object]]:
-  """The last heartbeat written under ``model_dir``, or None."""
-  path = os.path.join(model_dir, HEARTBEAT_FILENAME)
-  if not os.path.exists(path):
-    return None
-  with open(path, encoding='utf-8') as f:
-    return json.load(f)
+def read_heartbeat(model_dir: str,
+                   process_index: Optional[int] = None
+                   ) -> Optional[Dict[str, object]]:
+  """The last heartbeat written under ``model_dir``, or None.
+
+  ``process_index`` selects one host's file in a fleet model_dir
+  (``heartbeat.<i>.json``); the default reads the single-process
+  ``heartbeat.json``, falling back to host 0's indexed file so existing
+  callers (doctor, summarize) keep working on a fleet dir.
+  """
+  # Indexed-wins, same precedence as discover_hosts: a model_dir holding
+  # BOTH names saw a single-process run before a fleet one, and the
+  # fleet's (indexed) heartbeat is the live evidence — preferring the
+  # bare leftover would page on a heartbeat nobody writes anymore.
+  if process_index is not None:
+    candidates = ['heartbeat.{}.json'.format(int(process_index))]
+    if int(process_index) == 0:
+      candidates.append(HEARTBEAT_FILENAME)
+  else:
+    candidates = ['heartbeat.0.json', HEARTBEAT_FILENAME]
+  for name in candidates:
+    path = os.path.join(model_dir, name)
+    if os.path.exists(path):
+      try:
+        with open(path, encoding='utf-8') as f:
+          return json.load(f)
+      except ValueError:
+        return None  # mid-replace race or torn tmp: treat as absent
+  return None
+
+
+def discover_hosts(model_dir: str) -> Dict[int, Dict[str, Optional[str]]]:
+  """Per-host stream files under one (possibly fleet) model_dir.
+
+  Returns ``{process_index: {'telemetry': path|None,
+  'heartbeat': path|None}}`` from the LIVE filenames only (rotated
+  ``.N`` generations belong to their live file and are stitched by
+  ``read_telemetry``). The bare single-process names map to host 0; an
+  explicitly indexed host-0 file wins over the bare name (a model_dir
+  holding both saw a single-process run before a fleet one — the
+  indexed stream is the fleet's).
+  """
+  hosts: Dict[int, Dict[str, Optional[str]]] = {}
+
+  def slot(index: int) -> Dict[str, Optional[str]]:
+    return hosts.setdefault(int(index), {'telemetry': None,
+                                         'heartbeat': None})
+
+  try:
+    names = sorted(os.listdir(model_dir))
+  except OSError:
+    return hosts
+  for name in names:
+    match = _HOST_TELEMETRY_RE.match(name)
+    if match:
+      slot(int(match.group(1)))['telemetry'] = os.path.join(model_dir, name)
+      continue
+    match = _HOST_HEARTBEAT_RE.match(name)
+    if match:
+      slot(int(match.group(1)))['heartbeat'] = os.path.join(model_dir, name)
+  bare_telemetry = os.path.join(model_dir, TELEMETRY_FILENAME)
+  if os.path.exists(bare_telemetry) and not slot(0)['telemetry']:
+    slot(0)['telemetry'] = bare_telemetry
+  bare_heartbeat = os.path.join(model_dir, HEARTBEAT_FILENAME)
+  if os.path.exists(bare_heartbeat) and not slot(0)['heartbeat']:
+    slot(0)['heartbeat'] = bare_heartbeat
+  # The bare probes above create an empty host-0 slot even when neither
+  # bare file exists; drop it unless something real landed there.
+  if not hosts.get(0, {}).get('telemetry') and \
+      not hosts.get(0, {}).get('heartbeat'):
+    hosts.pop(0, None)
+  return hosts

@@ -32,7 +32,11 @@ Three further kinds — ``pipeline_stall``, ``worker_starvation``, and
 ``transfer_regression`` — are detected by the pipeline X-ray
 (observability/pipeline_xray.py) over the ``pipeline/<stage>/...``
 counters and flow through the same ``watchdog/anomalies`` counter
-family, telemetry ``anomaly`` records, and capture-request loop.
+family, telemetry ``anomaly`` records, and capture-request loop. Two
+FLEET kinds — ``straggler`` (one host's step time >= 2x the fleet
+median) and ``host_dead`` (one host's heartbeat stale while others
+advance) — are detected by ``observability/fleet.py``'s FleetWatchdog
+over the per-host heartbeat streams and flow through the same loop.
 
 The watchdog holds no threads and does no I/O: ``observe()`` is a pure
 in-memory pass the trainer calls at its log cadence, and every duration
@@ -50,7 +54,8 @@ from tensor2robot_tpu.observability import registry as registry_lib
 
 __all__ = ['Anomaly', 'Watchdog', 'WatchdogConfig',
            'ANOMALY_COUNTER', 'RECOMPILE_GAUGE', 'FEED_SHAPES_GAUGE',
-           'DEVICE_BYTES_GAUGE', 'check_heartbeat']
+           'DEVICE_BYTES_GAUGE', 'STRAGGLER', 'HOST_DEAD',
+           'check_heartbeat']
 
 # Metric names this watchdog reads (writers: trainer + data/device_feed +
 # observability/signals.py) and writes (the anomaly counter family).
@@ -64,6 +69,9 @@ GOODPUT_DROP = 'goodput_drop'
 RECOMPILE = 'recompile'
 HBM_GROWTH = 'hbm_growth'
 HEARTBEAT_STALE = 'heartbeat_stale'
+# Fleet kinds, detected by observability/fleet.py (FleetWatchdog):
+STRAGGLER = 'straggler'
+HOST_DEAD = 'host_dead'
 
 
 class Anomaly:

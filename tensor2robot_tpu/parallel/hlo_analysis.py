@@ -82,6 +82,37 @@ def collective_stats(hlo_text: str) -> Dict[str, Dict[str, int]]:
   return {k: v for k, v in stats.items() if v['count']}
 
 
+_INSTR_NAME_RE = re.compile(r'^\s*(?:ROOT\s+)?%(?P<name>[\w.-]+)\s*=')
+
+
+def collective_ops(hlo_text: str):
+  """Per-INSTRUCTION collective index: [{'name', 'kind', 'bytes'}].
+
+  ``collective_stats`` aggregates by kind; this keeps the instruction
+  names (``all-reduce.1`` — the same names the profiler's device line
+  carries as op events), so forensics can join "which op burned the
+  time" (xplane) with "what that op moves" (HLO) and name the gating
+  collective of a straggler capture. Async ``-start`` ops keep the
+  start name (that is where the device time lands) with the same
+  halved-tuple byte rule as ``collective_stats``.
+  """
+  ops = []
+  for line in hlo_text.splitlines():
+    m = _OP_RE.search(line)
+    if not m:
+      continue
+    name_match = _INSTR_NAME_RE.match(line)
+    nbytes = _shape_bytes(m.group('shapes'))
+    if m.group('variant'):
+      nbytes //= 2
+    ops.append({
+        'name': name_match.group('name') if name_match else m.group('kind'),
+        'kind': m.group('kind'),
+        'bytes': nbytes,
+    })
+  return ops
+
+
 def compiled_collective_stats(jitted_fn, *args, **kwargs):
   """Convenience: lower+compile a jitted fn and analyze its collectives."""
   compiled = jitted_fn.lower(*args, **kwargs).compile()

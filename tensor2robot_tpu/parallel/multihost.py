@@ -52,7 +52,11 @@ def multihost_dryrun(workdir: str, num_processes: int, process_id: int,
   shards assemble into one global batch (each host reads DIFFERENT files),
   (c) the jitted step runs with gradients psummed across hosts, (d) the
   Orbax checkpoint written cooperatively restores to identical params on
-  every host.
+  every host, and (e — ISSUE 9) each host emitted its OWN
+  ``telemetry.<process_index>.jsonl`` under the SHARED model_dir (two
+  processes appending one file would interleave torn lines), stamped
+  with its identity, and host 0's fleet view federates every host's
+  stream.
   """
   import jax
   import numpy as np
@@ -104,6 +108,27 @@ def multihost_dryrun(workdir: str, num_processes: int, process_id: int,
   # Per-host file shards come from the process-aware train() defaults.
   state = trainer.train(generator, max_train_steps=train_steps)
   assert int(jax.device_get(state.step)) == train_steps
+
+  # Fleet observatory: this process wrote ITS stream (indexed, stamped)…
+  from tensor2robot_tpu.observability import fleet as fleet_lib
+  from tensor2robot_tpu.observability import telemetry_file
+
+  own_stream = os.path.join(
+      model_dir, 'telemetry.{}.jsonl'.format(process_id))
+  assert os.path.exists(own_stream), own_stream
+  own_records = telemetry_file.read_telemetry(own_stream)
+  assert own_records and all(
+      r.get('process_index') == process_id and
+      r.get('process_count') == num_processes for r in own_records), (
+          'per-host records missing their identity stamp')
+  multihost_utils.sync_global_devices('telemetry_written')
+  # …and host 0 federates every host's stream into one fleet view.
+  if process_id == 0:
+    fleet = fleet_lib.read_fleet(model_dir)
+    assert sorted(fleet['hosts']) == list(range(num_processes)), (
+        sorted(fleet['hosts']), num_processes)
+    summary = fleet_lib.fleet_summary(model_dir)
+    assert summary['host_count'] == num_processes, summary
 
   # Params must agree across hosts (the gradient psum is global).
   flat = jax.tree_util.tree_leaves(jax.device_get(state.params))

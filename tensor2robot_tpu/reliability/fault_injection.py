@@ -18,6 +18,12 @@ Sites wired in this codebase (docs/reliability.md):
     sleep stalling the data path (``DATA_STALL_SECONDS``), the symptom
     the pipeline X-ray must catch as ``pipeline_stall`` and attribute
     to the transfer stage (docs/observability.md "Pipeline X-ray")
+  * ``host.preempt``  trainer loop → drives the FULL preemption path
+    (emergency save → recovery marker → TrainingPreempted) without a
+    real SIGTERM, so the recovery timeline (``t2r.recovery.v1``,
+    docs/observability.md "Fleet observatory") is measurable
+    deterministically — the injected-preemption half of ROADMAP item
+    4's ``preemption_recovery_seconds`` metric
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -36,9 +42,15 @@ SITE_DATA_READ = 'data.read'
 SITE_STEP_NAN = 'step.nan'
 SITE_STEP_SLOW = 'step.slow'
 SITE_DATA_STALL = 'data.stall'
+SITE_HOST_PREEMPT = 'host.preempt'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
-               SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL)
+               SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL,
+               SITE_HOST_PREEMPT)
+
+# Signum stamped into preemption records driven by the injected
+# 'host.preempt' site (no real signal was delivered).
+INJECTED_PREEMPT_SIGNUM = -1
 
 # How long one fired 'step.slow' stalls the loop. Module-level (not per
 # armament) so tests tune it with a monkeypatch, matching the fixed

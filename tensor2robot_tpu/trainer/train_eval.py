@@ -42,6 +42,7 @@ from tensor2robot_tpu.observability import (
     get_registry,
     span,
 )
+from tensor2robot_tpu.observability import fleet as fleet_lib
 from tensor2robot_tpu.observability import goodput as goodput_lib
 from tensor2robot_tpu.observability import pipeline_xray as xray_lib
 from tensor2robot_tpu.observability import signals as signals_lib
@@ -122,6 +123,8 @@ class Trainer:
                watchdog_config: Optional[WatchdogConfig] = None,
                enable_pipeline_xray: bool = True,
                xray_config: Optional[xray_lib.XrayConfig] = None,
+               enable_fleet: Optional[bool] = None,
+               fleet_config: Optional[fleet_lib.FleetConfig] = None,
                nan_policy: str = 'skip',
                nan_rollback_budget: int = 3,
                nan_check_every_n_steps: int = 1,
@@ -149,6 +152,14 @@ class Trainer:
     naming the gating stage and its headroom vs. the device rate, and
     the pipeline anomaly kinds (pipeline_stall / worker_starvation /
     transfer_regression) feed the same capture loop as the watchdog's.
+    enable_fleet / fleet_config: fleet observation at the log cadence
+    (docs/observability.md "Fleet observatory"): reads every host's
+    heartbeat under the shared model_dir, emits a ``t2r.fleet.v1``
+    telemetry record (per-host table, skew, gating host, fleet-min
+    goodput), and routes ``straggler`` / ``host_dead`` anomalies into
+    the same budgeted-capture loop. ``None`` (default) auto-enables on
+    multi-process runs; ``True`` forces it on (single-process runs with
+    simulated peers — tests, the MULTICHIP fleet phase).
     nan_policy: what the non-finite-loss sentinel does
     (docs/reliability.md): 'skip' (default) discards the poisoned update
     on device — params/opt state keep their pre-step values, only the
@@ -220,6 +231,10 @@ class Trainer:
                       else None)
     self._xray = (xray_lib.PipelineXray(xray_config)
                   if enable_pipeline_xray else None)
+    self._enable_fleet = enable_fleet
+    self._fleet_config = fleet_config
+    self._fleet_observer: Optional[fleet_lib.FleetObserver] = None
+    self._host_identity: Optional[Dict[str, object]] = None
     # Compile-event accounting (jax/compiles, jax/compile_ms) feeds the
     # watchdog's recompile detection; idempotent per process.
     signals_lib.install_jax_listeners()
@@ -283,11 +298,38 @@ class Trainer:
     return self._eval_writer
 
   @property
+  def host_identity(self) -> Dict[str, object]:
+    """This process's fleet identity (cached): the host_meta stamp every
+    telemetry record/heartbeat and forensics report carries."""
+    if self._host_identity is None:
+      self._host_identity = signals_lib.host_identity()
+    return self._host_identity
+
+  @property
   def telemetry_logger(self):
-    """Lazy telemetry.jsonl + heartbeat writer (None when metrics are off)."""
+    """Lazy telemetry.jsonl + heartbeat writer (None when metrics are off).
+
+    Multi-process runs get per-host filenames
+    (``telemetry.<process_index>.jsonl``) via the identity host_meta —
+    N processes sharing one model_dir must never append to one file.
+    """
     if self._write_metrics and self._telemetry is None:
-      self._telemetry = TelemetryLogger(self.model_dir)
+      self._telemetry = TelemetryLogger(self.model_dir,
+                                        host_meta=self.host_identity)
     return self._telemetry
+
+  @property
+  def fleet_observer(self) -> Optional[fleet_lib.FleetObserver]:
+    """Lazy fleet observer (None when disabled/single-process)."""
+    enabled = self._enable_fleet
+    if enabled is None:
+      enabled = int(self.host_identity.get('process_count') or 1) > 1
+    if not enabled or not self._write_metrics:
+      return None
+    if self._fleet_observer is None:
+      self._fleet_observer = fleet_lib.FleetObserver(
+          self.model_dir, self.host_identity, config=self._fleet_config)
+    return self._fleet_observer
 
   @property
   def last_goodput(self):
@@ -635,8 +677,13 @@ class Trainer:
     iterator = input_generator.create_dataset_iterator(
         mode=ModeKeys.TRAIN, shard_index=shard_index, num_shards=num_shards)
     features, labels = next(iterator)
+    restore_s = 0.0
     if state is None:
+      # Timed for the recovery timeline: after a preemption this is the
+      # mesh/state rebuild + checkpoint restore phase.
+      restore_t0 = time.perf_counter()
       state = self.init_state(features, labels)
+      restore_s = time.perf_counter() - restore_t0
     step_fn = self._compile_train_step()
     base_rng = jax.device_put(jax.random.PRNGKey(self.seed + 1),
                               NamedSharding(self.mesh, P()))
@@ -679,6 +726,7 @@ class Trainer:
     self._auto_profiler.context_fn = \
         lambda: {'goodput': tracker.fractions(),
                  'tuned_config': self.active_config_id,
+                 'host': self.host_identity,
                  'pipeline': (self._xray.last_record
                               if self._xray is not None else None)}
     self._auto_profiler.hlo_text_fn = self._train_step_hlo
@@ -688,6 +736,16 @@ class Trainer:
                     max_train_steps=int(max_train_steps),
                     batch_size=batch_size, nan_policy=self._nan_policy)
       telemetry.flush()
+    # A pending recovery marker means the previous incarnation of this
+    # model_dir died in a preemption: the first completed step closes
+    # the recovery timeline (t2r.recovery.v1, fleet.py).
+    pending_recovery = None
+    if telemetry is not None:
+      marker = fleet_lib.consume_recovery_marker(
+          self.model_dir,
+          process_index=self.host_identity.get('process_index'))
+      if marker is not None:
+        pending_recovery = (marker, restore_s, time.perf_counter())
 
     def commit_goodput(iter_start, data_s, ckpt_s, retry_s):
       # ``productive`` is the remainder, so the categories partition the
@@ -738,6 +796,25 @@ class Trainer:
               time.sleep(slow_s)
             step_i += 1
             steps_since_log += 1
+            if pending_recovery is not None:
+              marker, marker_restore_s, resume_t0 = pending_recovery
+              pending_recovery = None
+              recovery = fleet_lib.build_recovery_record(
+                  marker, marker_restore_s,
+                  time.perf_counter() - resume_t0, step_i)
+              registry.gauge(fleet_lib.RECOVERY_GAUGE).set(
+                  recovery['preemption_recovery_seconds'])
+              _log('Recovered from preemption at step %s in %.1f s '
+                   '(save %.1fs, down %.1fs, restore %.1fs, first step '
+                   '%.1fs).', recovery['preempted_step'],
+                   recovery['preemption_recovery_seconds'],
+                   recovery['phases']['emergency_save_s'],
+                   recovery['phases']['downtime_s'],
+                   recovery['phases']['restore_s'],
+                   recovery['phases']['first_step_s'])
+              if telemetry is not None:
+                telemetry.log('recovery', step=step_i, **recovery)
+                telemetry.flush()
             # The sentinel also fires on every step that is about to be
             # checkpointed (periodic or final): with nan_check_every_n_steps
             # > 1 an unvetted save could otherwise commit NaN params, and a
@@ -793,6 +870,26 @@ class Trainer:
                                   detail=anomaly.detail)
                   self._auto_profiler.request_capture(
                       anomaly.kind, step_i, anomaly.detail)
+              fleet_record = None
+              if self.fleet_observer is not None:
+                # Fleet before watchdog: a straggler IS a step-time
+                # regression locally, but the fleet kind carries the
+                # host attribution — it should claim the capture.
+                fleet_record, fleet_anomalies = \
+                    self.fleet_observer.observe(
+                        step_i, step_time_s=step_time_s,
+                        examples_per_sec=examples_per_sec,
+                        productive_fraction=tracker.fractions().get(
+                            'productive'))
+                for anomaly in fleet_anomalies:
+                  _log('Fleet anomaly: %s', anomaly.message)
+                  if telemetry is not None:
+                    telemetry.log('anomaly', step=step_i,
+                                  anomaly=anomaly.kind,
+                                  message=anomaly.message,
+                                  detail=anomaly.detail)
+                  self._auto_profiler.request_capture(
+                      anomaly.kind, step_i, anomaly.detail)
               if self._watchdog is not None:
                 for anomaly in self._watchdog.observe(
                     step_i, step_time_s, tracker.seconds()):
@@ -827,6 +924,7 @@ class Trainer:
                 telemetry.log('train', step=step_i,
                               loss=_json_scalar(metrics.get('loss')),
                               examples_per_sec=examples_per_sec,
+                              step_time_s=step_time_s,
                               goodput=tracker.fractions(),
                               goodput_seconds=tracker.seconds(),
                               counters=snapshot['counters'],
@@ -835,7 +933,18 @@ class Trainer:
                   # The t2r.pipeline.v1 attribution record: gating stage
                   # + headroom vs. the device rate, per log window.
                   telemetry.log('pipeline', step=step_i, **pipeline_record)
-                telemetry.heartbeat(step_i)
+                if fleet_record is not None:
+                  # The t2r.fleet.v1 federation record: per-host table,
+                  # skew, gating host, fleet-min goodput, per window.
+                  telemetry.log('fleet', step=step_i, **fleet_record)
+                # Window stats ride the heartbeat so a peer's
+                # FleetObserver can read the whole fleet's health from
+                # N tiny atomic files instead of N telemetry re-parses.
+                telemetry.heartbeat(
+                    step_i, step_time_s=step_time_s,
+                    examples_per_sec=examples_per_sec,
+                    productive_fraction=tracker.fractions().get(
+                        'productive'))
                 telemetry.flush()
               t_last = time.perf_counter()
               steps_since_log = 0
@@ -845,20 +954,35 @@ class Trainer:
               ckpt_s += time.perf_counter() - ckpt_t0
             for hook in hooks:
               hook.after_step(self, state, step_i, metrics)
+            preempt_signum = None
             if shutdown.requested:
+              preempt_signum = int(shutdown.signum)
+            elif fault_injection.fires(fault_injection.SITE_HOST_PREEMPT):
+              # The injected host-preemption site: the SAME end-to-end
+              # path a SIGTERM drives, deterministically — what makes
+              # the recovery timeline a measurable, testable quantity.
+              preempt_signum = fault_injection.INJECTED_PREEMPT_SIGNUM
+            if preempt_signum is not None:
               # Commit everything before re-raising: the restart resumes
               # from this exact step instead of the last periodic save.
               ckpt_t0 = time.perf_counter()
               self.save_checkpoint(state, force=True)
               self.checkpoint_manager.wait_until_finished()
-              ckpt_s += time.perf_counter() - ckpt_t0
+              save_s = time.perf_counter() - ckpt_t0
+              ckpt_s += save_s
               registry.counter('reliability/preemptions').inc()
               if telemetry is not None:
                 telemetry.log('preempted', step=step_i,
-                              signum=int(shutdown.signum))
+                              signum=preempt_signum)
                 telemetry.heartbeat(step_i)
                 telemetry.flush()
-              raise TrainingPreempted(shutdown.signum, step_i)
+                # Start the recovery clock: the resuming process (a
+                # different pid) consumes this marker and emits the
+                # t2r.recovery.v1 record at its first completed step.
+                fleet_lib.write_recovery_marker(
+                    self.model_dir, step_i, preempt_signum, save_s,
+                    process_index=self.host_identity.get('process_index'))
+              raise TrainingPreempted(preempt_signum, step_i)
             if step_i < max_train_steps:
               with span('data.next') as sp:
                 batch = next(iterator)

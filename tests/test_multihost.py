@@ -5,6 +5,18 @@ through jax.distributed: per-host input shards, a global 8-device mesh,
 cross-host gradient psums, and a cooperatively-written Orbax checkpoint
 that restores identically on both hosts
 (tensor2robot_tpu/parallel/multihost.py:multihost_dryrun asserts each).
+
+ISSUE 9 revisit of the xfail: probed directly, jax.distributed
+INITIALIZES fine here — both processes reach the first
+``sync_global_devices`` and then die with ``INVALID_ARGUMENT:
+Multiprocess computations aren't implemented on the CPU backend``
+(jaxlib 0.4.x). The skew is structural to this container's backend, not
+a coordination/port flake, so the xfail stays (with the accurate
+reason) and the FLEET federation tests do NOT inherit it: they run on
+the subprocess fixture ``observability/fleet_sim.py`` (two real
+processes writing per-host telemetry under one model_dir — the
+federation contract is files, not collectives; see
+tests/test_fleet.py::TestTwoProcessFederation).
 """
 
 import os
@@ -25,9 +37,11 @@ def _free_port() -> int:
 
 @pytest.mark.xfail(
     strict=False,
-    reason='pre-existing env skew (CHANGES.md PR 4): the two-process '
-    'jax.distributed dryrun fails to initialize on this container '
-    '(loopback coordination service) — not a repo regression')
+    reason='pre-existing env skew (CHANGES.md PR 4, re-probed in PR 9): '
+    'jaxlib\'s CPU backend does not implement multi-process '
+    'computations ("Multiprocess computations aren\'t implemented on '
+    'the CPU backend" at the first sync_global_devices) — not a repo '
+    'regression; fleet federation is covered jax-free in test_fleet.py')
 def test_two_process_train_checkpoint_restore(tmp_path):
   workdir = str(tmp_path / 'mh')
   os.makedirs(workdir)
