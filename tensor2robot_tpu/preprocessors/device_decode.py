@@ -65,8 +65,31 @@ def sparse_coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
   return out
 
 
+def packed_coef_specs(key: str, spec: TensorSpec) -> SpecStruct:
+  """The four packed-wire tensors replacing one image spec.
+
+  The nibble/escape stream dims are dynamic (bucketed per batch by the
+  native loader) and declared None; the DC-delta plane is fixed (one
+  nibble per block, two per byte); the quant table is batch-HOISTED —
+  it ships as a single [1, 3, 64] array per batch, not per example
+  (data/native_loader.py _hoisted_quant_table), and the device-side
+  unpack broadcasts it back before the jitted step.
+  """
+  from tensor2robot_tpu.data.native_loader import packed_dc_count
+
+  out = SpecStruct()
+  name = spec.name or key
+  out[key + '/pw'] = TensorSpec((None,), np.uint8, name=name + '/pw')
+  out[key + '/se'] = TensorSpec((None,), np.int16, name=name + '/se')
+  out[key + '/dcn'] = TensorSpec((packed_dc_count(spec) // 2,), np.uint8,
+                                 name=name + '/dcn')
+  out[key + '/qt'] = TensorSpec((3, 64), np.uint16, name=name + '/qt')
+  return out
+
+
 def wrap_model_with_device_decode(model=None, sparse: bool = True,
-                                  sparse_density: float = 0.5):
+                                  sparse_density: float = 0.5,
+                                  wire_format: str = None):
   """Config-surface helper: switch a model to the split-decode input path.
 
   Gin usage (the one-line production wiring)::
@@ -77,12 +100,16 @@ def wrap_model_with_device_decode(model=None, sparse: bool = True,
   With ``sparse=True`` (default) the input pipeline ships bucketed sparse
   DCT entry streams — ~8x fewer host->device bytes on camera frames; the
   Trainer unpacks them between transfer and the jitted step.
+  ``wire_format='packed'`` selects the bit-packed wire instead (~1.8x
+  fewer bytes again; requires batch-uniform JPEG quant tables — see
+  docs/performance.md "Transfer path").
   """
   if model is None:
     raise ValueError('wrap_model_with_device_decode requires a model.')
   model.set_preprocessor(
       DeviceDecodePreprocessor(model.preprocessor, sparse=sparse,
-                               sparse_density=sparse_density))
+                               sparse_density=sparse_density,
+                               wire_format=wire_format))
   return model
 
 
@@ -91,19 +118,32 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
 
   ``sparse=True`` additionally ships the coefficients as sparse
   delta/value entry streams (~8x fewer host->device bytes on realistic
-  camera frames; data/native/record_loader.cc decode_jpeg_coef_sparse).
-  The Trainer unpacks them to dense coefficient tensors right after
-  transfer (data/device_feed.py) so the train step never sees the
-  dynamic bucketed shapes; host-side ``preprocess`` calls also accept
-  sparse features directly for tests and numpy pipelines.
+  camera frames; data/native/record_loader.cc decode_jpeg_coef_sparse);
+  ``wire_format='packed'`` tightens that to the bit-packed wire
+  (nibble-coded entries, DC-delta plane, batch-hoisted quant tables —
+  ~1.8x fewer bytes again; decode_jpeg_coef_packed). Either way the
+  Trainer unpacks to dense coefficient tensors right after transfer
+  (data/device_feed.py) so the train step never sees the dynamic
+  bucketed shapes; host-side ``preprocess`` calls also accept sparse or
+  packed features directly for tests and numpy pipelines.
   """
 
   def __init__(self, inner: AbstractPreprocessor, sparse: bool = False,
-               sparse_density: float = 0.5):
+               sparse_density: float = 0.5, wire_format: str = None):
     super().__init__(inner._model_feature_specification_fn,
                      inner._model_label_specification_fn)
     self._inner = inner
-    self.sparse = bool(sparse)
+    # ``wire_format`` is the one authority ('dense' | 'sparse' |
+    # 'packed'); the ``sparse`` bool remains as the original config
+    # surface and maps onto it when wire_format is not given.
+    if wire_format is None:
+      wire_format = 'sparse' if sparse else 'dense'
+    if wire_format not in ('dense', 'sparse', 'packed'):
+      raise ValueError(
+          "wire_format must be 'dense', 'sparse' or 'packed'; got {!r}."
+          .format(wire_format))
+    self.wire_format = wire_format
+    self.sparse = wire_format == 'sparse'
     # Entry capacity as a fraction of the total coefficient count; the
     # input generator passes it to the native loader plan. Camera frames
     # run ~12-14% nonzero; raise toward 1.0 for unusually dense imagery
@@ -146,7 +186,9 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
   def get_in_feature_specification(self, mode: str) -> SpecStruct:
     spec = algebra.flatten_spec_structure(
         self._inner.get_in_feature_specification(mode))
-    make_specs = sparse_coef_specs if self.sparse else coef_specs
+    make_specs = {'sparse': sparse_coef_specs,
+                  'packed': packed_coef_specs,
+                  'dense': coef_specs}[self.wire_format]
     out = SpecStruct()
     for key in spec:
       if coef_eligible(spec[key]):
@@ -171,15 +213,19 @@ class DeviceDecodePreprocessor(AbstractPreprocessor):
     (which validates against its own in-specs)."""
     features = SpecStruct(**{k: features[k] for k in features})
     keys = self.image_keys(mode)
-    if any(key + '/sd' in features for key in keys):
-      # Sparse streams straight from the loader (host/test convenience;
-      # the Trainer path unpacks BEFORE the jitted step via
+    if any(key + '/sd' in features or key + '/pw' in features
+           for key in keys):
+      # Sparse/packed streams straight from the loader (host/test
+      # convenience; the Trainer path unpacks BEFORE the jitted step via
       # data/device_feed.py to keep the step shape-stable).
       spec = algebra.flatten_spec_structure(
           self._inner.get_in_feature_specification(mode))
-      features = jpeg_device.unpack_sparse_features(
-          features,
-          {key: (spec[key].shape[0], spec[key].shape[1]) for key in keys})
+      shapes = {key: (spec[key].shape[0], spec[key].shape[1])
+                for key in keys}
+      if any(key + '/pw' in features for key in keys):
+        features = jpeg_device.unpack_packed_features(features, shapes)
+      else:
+        features = jpeg_device.unpack_sparse_features(features, shapes)
     features = jpeg_device.decode_coef_features(features, keys)
     return self._inner.preprocess(features, labels, mode, rng=rng)
 

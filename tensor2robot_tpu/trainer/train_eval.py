@@ -130,7 +130,8 @@ class Trainer:
                nan_check_every_n_steps: int = 1,
                owns_checkpoint_dir: bool = True,
                tuned_config: Optional[Any] = None,
-               tuning_cache_path: Optional[str] = None):
+               tuning_cache_path: Optional[str] = None,
+               feed_depth: int = 1):
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
     per-eval-run dirs, ref utils/train_eval.py:539-547).
@@ -188,6 +189,17 @@ class Trainer:
     perf regression is attributable to the config that produced it.
     tuning_cache_path: cache file for the string form (default:
     tuning.default_cache_path()).
+    feed_depth: > 1 pipelines the train channel's host->device hop
+    through an N-deep :class:`~tensor2robot_tpu.data.device_feed.
+    PipelinedFeed`: a producer thread transfers batches k+1..k+depth
+    (decode + copy, sparse/packed unpack dispatch) while the device runs
+    step k, so on a transfer-limited host the copy hides under compute
+    instead of serializing with it (docs/performance.md "Transfer
+    path"). The goodput 'data' fraction then measures only the time the
+    loop actually WAITED for a buffered batch; the X-ray transfer stage
+    keeps timing each copy to completion in the producer thread, so
+    MB/s attribution is unchanged. 1 (default) keeps the synchronous
+    hop.
     """
     self.model = model
     self.model_dir = model_dir
@@ -252,6 +264,7 @@ class Trainer:
     self._device_feed_built = False
     self._tuned_config = tuned_config
     self._tuning_cache_path = tuning_cache_path
+    self._feed_depth = max(1, int(feed_depth))
     self._train_step_compiled = None  # AOT executable under tuned options
     self.active_config_id: Optional[str] = None
 
@@ -703,6 +716,27 @@ class Trainer:
     metrics = None
     step_i = start_step
     batch = (features, labels)
+    # feed_depth > 1: route the train channel through the N-deep
+    # pipelined feed — the producer thread decodes AND transfers batches
+    # ahead while the device computes, so the loop below only ever waits
+    # on an already-resident batch (the wait is the honest goodput
+    # 'data' cost). The first batch — already drawn for init_state — is
+    # chained back in so no data is skipped.
+    pipelined = None
+    if self._feed_depth > 1:
+      import itertools
+
+      from tensor2robot_tpu.data.device_feed import PipelinedFeed
+
+      def _host_batch(pair):
+        batch_features, batch_labels = pair
+        return {'features': batch_features.to_dict(),
+                'labels': (batch_labels.to_dict()
+                           if batch_labels is not None else None)}
+
+      pipelined = PipelinedFeed(
+          map(_host_batch, itertools.chain([batch], iterator)),
+          self._put_batch, depth=self._feed_depth)
     rollback_budget = self._nan_rollback_budget
     host_nan_check = self._nan_policy in ('raise', 'rollback')
     completed = False
@@ -771,12 +805,18 @@ class Trainer:
             if report_path is not None and telemetry is not None:
               telemetry.log('forensics', step=step_i, report=report_path)
               telemetry.flush()
-            features, labels = batch
             with span('data.put_batch') as sp:
-              device_batch = self._put_batch(
-                  {'features': features.to_dict(),
-                   'labels': labels.to_dict() if labels is not None
-                   else None})
+              if pipelined is not None:
+                # Blocks only while the buffer is EMPTY — the producer
+                # thread owns decode + transfer; transfer telemetry and
+                # the data.stall site fire there (device_feed.py).
+                device_batch = pipelined.get()
+              else:
+                features, labels = batch
+                device_batch = self._put_batch(
+                    {'features': features.to_dict(),
+                     'labels': labels.to_dict() if labels is not None
+                     else None})
             data_s += sp.elapsed
             force_nan = np.asarray(
                 fault_injection.fires(fault_injection.SITE_STEP_NAN))
@@ -833,9 +873,10 @@ class Trainer:
                 rollback_budget -= 1
                 steps_since_log = 0
                 t_last = time.perf_counter()
-                with span('data.next') as sp:
-                  batch = next(iterator)
-                retry_s += sp.elapsed
+                if pipelined is None:
+                  with span('data.next') as sp:
+                    batch = next(iterator)
+                  retry_s += sp.elapsed
                 continue
             if (step_i % self.log_every_n_steps == 0
                 or step_i == max_train_steps):
@@ -983,7 +1024,7 @@ class Trainer:
                     self.model_dir, step_i, preempt_signum, save_s,
                     process_index=self.host_identity.get('process_index'))
               raise TrainingPreempted(preempt_signum, step_i)
-            if step_i < max_train_steps:
+            if step_i < max_train_steps and pipelined is None:
               with span('data.next') as sp:
                 batch = next(iterator)
               data_s += sp.elapsed
@@ -991,6 +1032,11 @@ class Trainer:
             commit_goodput(iter_start, data_s, ckpt_s, retry_s)
         completed = True
       finally:
+        if pipelined is not None:
+          # Stop the producer on EVERY exit path — a live thread parked
+          # inside the native loader's next() would otherwise race the
+          # stream teardown below (and at interpreter exit).
+          pipelined.close()
         # A dangling profiler trace breaks the next start_trace: close
         # it on EVERY exit path. Clean completion gets the full
         # forensics report; failure paths just stop the trace (the

@@ -594,6 +594,18 @@ class TestTelemetryCLI:
     assert 'span/train.step' in result.stdout or 'examples/sec' \
         in result.stdout
 
+  def test_summarize_stage_table_reports_bytes(self, trained_run):
+    # ISSUE 10 satellite: per-stage BYTES alongside examples in the
+    # pipeline stage table — wire-compression wins must be visible in
+    # live runs, not only in bench reruns.
+    result = self._run('summarize', trained_run)
+    assert result.returncode == 0, result.stderr
+    assert 'pipeline @ step' in result.stdout
+    table = [line for line in result.stdout.splitlines()
+             if line.startswith('  transfer')]
+    assert table, result.stdout
+    assert 'B/ex)' in table[0], table[0]
+
   def test_tail_pretty_prints_records(self, trained_run):
     result = self._run('tail', trained_run)
     assert result.returncode == 0, result.stderr

@@ -387,6 +387,43 @@ class TestDoubleBufferedFeed:
     assert buffered.close(timeout=30)
     assert fresh_registry.scalars()[BUFFER_OCCUPANCY_GAUGE] == 0.0
 
+  def test_deep_feed_drains_in_order_under_stall_no_torn_batches(
+      self, fresh_registry, monkeypatch):
+    """ISSUE 10 satellite: a ``data.stall`` on the hop with depth N must
+    drain IN ORDER and never deliver a torn/mixed-version batch — every
+    leaf of every delivered batch carries one version, in sequence."""
+    from tensor2robot_tpu.data.device_feed import PipelinedFeed
+
+    monkeypatch.setattr(fault_injection, 'DATA_STALL_SECONDS', 0.05)
+    fault_injection.set_injector(
+        fault_injection.FaultInjector().fail('data.stall', times=3,
+                                             after=4))
+
+    def versioned(n):
+      for i in range(n):
+        yield {'features': {'a': np.full((4, 3), i, np.float32),
+                            'b': np.full((4, 7), i, np.float32)},
+               'labels': {'y': np.full((4, 1), i, np.float32)}}
+
+    buffered = PipelinedFeed(versioned(12), self._feed(), depth=4)
+    seen = []
+    for batch in buffered:
+      versions = {float(np.asarray(leaf).ravel()[0])
+                  for leaf in (batch['features']['a'],
+                               batch['features']['b'],
+                               batch['labels']['y'])}
+      assert len(versions) == 1, 'torn batch: {}'.format(versions)
+      uniform = {float(v)
+                 for v in np.asarray(batch['features']['a']).ravel()}
+      assert len(uniform) == 1, 'torn rows: {}'.format(uniform)
+      seen.append(versions.pop())
+    assert seen == [float(i) for i in range(12)]
+    assert buffered.close()
+    # Every batch crossed the metered hop exactly once, stall included.
+    scalars = fresh_registry.scalars()
+    assert scalars['pipeline/transfer/ms/count'] == 12.0
+    assert scalars['pipeline/transfer/examples'] == 48.0
+
 
 # -- the acceptance loop -----------------------------------------------------
 
@@ -479,6 +516,44 @@ class TestXrayLoop:
     assert report['pipeline']['schema'] == 't2r.pipeline.v1'
     assert report['pipeline']['bottleneck'] == 'transfer'
     assert 'transfer' in report['pipeline']['stages']
+
+  def test_injected_stall_with_deep_feed_one_capture(
+      self, tmp_path, fresh_registry, monkeypatch):
+    """ISSUE 10 satellite: the SAME acceptance shape through the N-deep
+    pipelined trainer feed (feed_depth=4) — the stall now fires in the
+    PRODUCER thread, the buffer drains in order, and the X-ray still
+    answers with exactly one budgeted pipeline capture attributing the
+    transfer stage."""
+    monkeypatch.setattr(fault_injection, 'DATA_STALL_SECONDS', 0.25)
+    fault_injection.set_injector(
+        fault_injection.FaultInjector().fail('data.stall', times=8,
+                                             after=8))
+    model_dir = str(tmp_path)
+    trainer = _make_trainer(
+        model_dir, log_every_n_steps=2, profile_budget=1,
+        profile_window_steps=2, profile_min_interval_secs=0.0,
+        enable_watchdog=False, feed_depth=4,
+        xray_config=xray_lib.XrayConfig(min_baseline_windows=2))
+    trainer.train(MockInputGenerator(batch_size=8), max_train_steps=24)
+    trainer.close()
+
+    records = obs.read_telemetry(model_dir)
+    anomalies = [r for r in records if r['kind'] == 'anomaly']
+    pipeline_kinds = (xray_lib.PIPELINE_STALL,
+                      xray_lib.TRANSFER_REGRESSION)
+    fired = [r for r in anomalies if r['anomaly'] in pipeline_kinds]
+    assert fired, anomalies
+    assert trainer.auto_profiler.captures_taken == 1
+    report_paths = glob.glob(os.path.join(model_dir, 'forensics',
+                                          '*.json'))
+    assert len(report_paths) == 1
+    with open(report_paths[0]) as f:
+      report = json.load(f)
+    assert report['reason'] in pipeline_kinds
+    # The training itself completed every step despite the stalls —
+    # the deep buffer delivered every batch exactly once, in order.
+    trains = [r for r in records if r['kind'] == 'train']
+    assert trains and trains[-1]['step'] == 24
 
 
 # -- doctor ------------------------------------------------------------------
