@@ -508,6 +508,165 @@ def _bench_e2e_from_disk(model_factory, mesh, batch_size: int,
   }
 
 
+def _bench_replay(model_factory, mesh, batch_size: int, record_path: str,
+                  disk_rate: float, n_steps: int = 6, reps: int = 3,
+                  feed_depth: int = 4, writers: int = 4,
+                  writer_throttle_s: float = 0.01):
+  """The replay axis (ISSUE 11): learner fed from the sharded service.
+
+  The SAME steady-state loop as :func:`_bench_e2e_from_disk`, with the
+  native stream replaced by a ``replay/`` service behind its HTTP door:
+  disk batches are split into per-example packed records, preloaded
+  over ``/v1/append``, and the learner samples megabatches through
+  ``ReplayBatchIterator`` -> ``PipelinedFeed`` while ``writers``
+  concurrent HTTP writers keep appending (throttled to
+  ``writer_throttle_s`` per append each — a balanced collect fleet, not
+  a denial-of-service of the learner's host CPU).
+
+  Returns the REPLAY_BENCH_KEYS quantities: sustained append+sample
+  rates under concurrent writers, learner examples/sec vs the disk
+  baseline (the <= 5% parity bar), and at-rest bytes/example vs the
+  wire (the <= 1.1x packed-at-rest bar; trimming bucket padding
+  normally lands it BELOW 1.0).
+  """
+  import threading
+
+  from tensor2robot_tpu.data import native_loader
+  from tensor2robot_tpu.data.device_feed import PipelinedFeed
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.observability import get_registry
+  from tensor2robot_tpu.preprocessors.device_decode import (
+      DeviceDecodePreprocessor,
+  )
+  from tensor2robot_tpu.replay import (
+      ReplayClient,
+      ReplayConfig,
+      ReplayService,
+  )
+  from tensor2robot_tpu.replay import wire as replay_wire
+  from tensor2robot_tpu.replay.feed import ReplayBatchIterator
+  from tensor2robot_tpu.replay.frontend import build_http_server
+  from tensor2robot_tpu.replay.service import REPLAY_SAMPLE_MS_HISTOGRAM
+  from tensor2robot_tpu.tuning.autotuner import robust_median_spread
+
+  model = model_factory()
+  model.set_preprocessor(
+      DeviceDecodePreprocessor(model.preprocessor, wire_format='packed'))
+  wrapped = model.preprocessor
+  raw_feature_spec = wrapped.raw_in_feature_specification(ModeKeys.TRAIN)
+  label_spec = wrapped.get_in_label_specification(ModeKeys.TRAIN)
+  plan = native_loader.plan_for_specs(raw_feature_spec, label_spec,
+                                      image_mode='coef_packed')
+  stream = native_loader.NativeBatchedStream(
+      plan, [record_path], batch_size=batch_size, shuffle=True, seed=0,
+      copy=True, validate=False)
+  blobs = []
+  wire_bytes = 0
+  try:
+    it = iter(stream)
+    for index in range(3):
+      features, labels = next(it)
+      fd = {k: np.asarray(features[k]) for k in features}
+      ld = {k: np.asarray(labels[k]) for k in labels}
+      if index == 0:
+        wire_bytes = sum(v.nbytes for v in fd.values()) + \
+            sum(v.nbytes for v in ld.values())
+      blobs.extend(replay_wire.split_batch(fd, ld))
+  finally:
+    stream.close()
+  wire_bytes_per_example = wire_bytes / batch_size
+
+  shard_capacity = max(64, -(-len(blobs) // 4))
+  service = ReplayService(ReplayConfig(
+      num_shards=4, batch_size=batch_size,
+      capacity_examples_per_shard=shard_capacity, seed=0)).start()
+  httpd, port = build_http_server(service)
+  http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  http_thread.start()
+  client = ReplayClient('127.0.0.1:{}'.format(port))
+  # One counter slot PER writer: a shared `x[0] += 1` across threads is
+  # load/add/store bytecode and drops increments under contention; the
+  # reader sums the slots.
+  appended = [0] * writers
+  stop_writers = threading.Event()
+  try:
+    for blob in blobs:  # preload: the learner must never run dry
+      client.append(blob)
+    at_rest = service.occupancy_bytes / max(1, service.occupancy_examples)
+
+    def _writer(index):
+      cursor = index
+      local_client = ReplayClient('127.0.0.1:{}'.format(port))
+      while not stop_writers.is_set():
+        local_client.append(blobs[cursor % len(blobs)])
+        appended[index] += 1  # single-writer slot: no lost increments
+        cursor += writers
+        if writer_throttle_s:
+          time.sleep(writer_throttle_s)
+
+    writer_threads = [threading.Thread(target=_writer, args=(i,),
+                                       daemon=True)
+                      for i in range(writers)]
+    with tempfile.TemporaryDirectory() as tmp:
+      first = client.sample(batch_size, wait=True)
+      from tensor2robot_tpu.replay.feed import to_spec_structs
+      first_features, first_labels = to_spec_structs(first)
+      trainer, state, step_fn, rng, _ = _trainer_step_setup(
+          model, mesh, batch_size, tmp,
+          sample_batch=(first_features, first_labels))
+      buffered = None
+      try:
+        for thread in writer_threads:
+          thread.start()
+        replay_it = ReplayBatchIterator(client, batch_size)
+        buffered = PipelinedFeed(
+            ({'features': f.to_dict(), 'labels': l.to_dict()}
+             for f, l in replay_it),
+            trainer._put_batch, depth=feed_depth)
+        batch = buffered.get()
+        state, _ = step_fn(state, batch['features'], batch['labels'], rng)
+        _sync(state)
+        walls = []
+        append_counts = []
+        for _ in range(reps):
+          appended0 = sum(appended)
+          t0 = time.time()
+          for _ in range(n_steps):
+            batch = buffered.get()
+            state, _ = step_fn(state, batch['features'], batch['labels'],
+                               rng)
+          _sync(state)
+          walls.append(time.time() - t0)
+          append_counts.append(sum(appended) - appended0)
+      finally:
+        stop_writers.set()
+        trainer.close()
+        if buffered is not None:
+          buffered.close(timeout=60)
+  finally:
+    stop_writers.set()
+    httpd.shutdown()
+    service.close()
+  rates = [batch_size * n_steps / wall for wall in walls]
+  rate, rate_spread = robust_median_spread(rates)
+  append_rate = sum(append_counts) / max(sum(walls), 1e-9)
+  sample_p99 = get_registry().histogram(
+      REPLAY_SAMPLE_MS_HISTOGRAM).summary().get('p99', 0.0)
+  return {
+      'replay_writers': writers,
+      'replay_append_examples_per_sec': round(append_rate, 2),
+      'replay_e2e_samples_per_sec': round(rate, 2),
+      'replay_e2e_samples_per_sec_spread': round(rate_spread, 2),
+      'replay_e2e_vs_disk': round(rate / disk_rate, 4)
+                            if disk_rate > 0 else -1.0,
+      'replay_sample_p99_ms': round(sample_p99, 2),
+      'replay_wire_bytes_per_example': round(wire_bytes_per_example, 1),
+      'replay_at_rest_bytes_per_example': round(at_rest, 1),
+      'replay_at_rest_overhead': round(at_rest / wire_bytes_per_example, 4)
+                                 if wire_bytes_per_example else -1.0,
+  }
+
+
 def _bench_qtopt(mesh, on_tpu: bool, tuned=None):
   """Headline QT-Opt step timing, chained dispatch (one sync per chain).
 
@@ -1777,8 +1936,29 @@ def main():
     missing = [key for key in E2E_WIRE_BENCH_KEYS if key not in out]
     if missing:
       out['e2e_schema_missing'] = missing
+
+    try:
+      # Replay axis (ISSUE 11): the SAME learner loop fed from the
+      # sharded replay service over HTTP, with 4 concurrent writers
+      # appending — the parity bars are e2e within 5% of the disk rate
+      # above and at-rest bytes/example within 1.1x of the wire.
+      replay = _bench_replay(
+          lambda: Grasping44E2EOpenCloseTerminateGripperStatusHeightToBottom(
+              device_type='tpu' if on_tpu else 'cpu'),
+          mesh, e2e_batch, record_path, disk_rate=e2e['rate'])
+      out.update(replay)
+      from tensor2robot_tpu.replay.service import REPLAY_BENCH_KEYS
+      replay_missing = [key for key in REPLAY_BENCH_KEYS
+                        if key not in out]
+      if replay_missing:
+        out['replay_schema_missing'] = replay_missing
+    except Exception as e:  # noqa: BLE001
+      out['replay_e2e_samples_per_sec'] = -1.0
+      out['replay_error'] = repr(e)[:200]
   except Exception:  # noqa: BLE001
     out['e2e_samples_per_sec'] = -1.0
+    if 'replay_e2e_samples_per_sec' not in out:
+      out['replay_e2e_samples_per_sec'] = -1.0  # no disk baseline to meet
     if 'transfer_mb_per_sec' not in out:
       # The link number must survive an e2e failure: fall back to a
       # dense random batch (the pre-round-10 payload) so the field is

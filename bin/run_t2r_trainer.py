@@ -22,6 +22,13 @@ def main(argv=None):
                       help='Path to a gin config file (repeatable).')
   parser.add_argument('--gin_bindings', action='append', default=[],
                       help="Individual binding, e.g. \"a.b = 1\" (repeatable).")
+  parser.add_argument('--replay_endpoint', default=None,
+                      help='Train from a t2r_replay service (host:port) '
+                           'instead of the configured record files: the '
+                           'learner samples packed megabatches at wire '
+                           'rate (docs/replay.md).')
+  parser.add_argument('--replay_batch_size', type=int, default=32,
+                      help='Sampled megabatch size with --replay_endpoint.')
   args = parser.parse_args(argv)
 
   from tensor2robot_tpu import config
@@ -31,7 +38,13 @@ def main(argv=None):
       os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
   config.parse_config_files_and_bindings(args.gin_configs, args.gin_bindings)
   train_eval_model = config.get_configurable('train_eval_model')
-  results = train_eval_model()
+  overrides = {}
+  if args.replay_endpoint:
+    from tensor2robot_tpu.replay import ReplayInputGenerator
+
+    overrides['input_generator_train'] = ReplayInputGenerator(
+        args.replay_endpoint, batch_size=args.replay_batch_size)
+  results = train_eval_model(**overrides)
   metrics = results.get('eval_metrics') if isinstance(results, dict) else None
   if metrics:
     print('final eval metrics:', metrics)

@@ -93,6 +93,8 @@ def register_all() -> None:
            'MultiEvalRecordInputGenerator')
   register(input_generators.DefaultRandomInputGenerator,
            'DefaultRandomInputGenerator')
+  from tensor2robot_tpu.replay import feed as replay_feed
+  register(replay_feed.ReplayInputGenerator, 'ReplayInputGenerator')
   register(input_generators.DefaultConstantInputGenerator,
            'DefaultConstantInputGenerator')
   register(meta_data.MetaRecordInputGenerator, 'MetaRecordInputGenerator')

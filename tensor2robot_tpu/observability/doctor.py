@@ -125,10 +125,11 @@ def diagnose(model_dir: str,
     if candidate and (beat is None or
                       candidate.get('time', 0) > beat.get('time', 0)):
       beat = candidate
-  # 'serving_stop' counts as an orderly end: a PolicyServer that closed
-  # cleanly stops heartbeating by design, which is not a wedged process.
+  # 'serving_stop'/'replay_stop' count as orderly ends: a PolicyServer
+  # or ReplayService that closed cleanly stops heartbeating by design,
+  # which is not a wedged process.
   run_ended = bool(records) and records[-1].get('kind') in (
-      'run_end', 'run_abort', 'preempted', 'serving_stop')
+      'run_end', 'run_abort', 'preempted', 'serving_stop', 'replay_stop')
   if run_ended and beat is not None:
     findings.append(_finding(
         INFO, 'run finished ({}); heartbeat age not meaningful'.format(
@@ -296,6 +297,72 @@ def diagnose(model_dir: str,
               latest.get('p99_ms', 0.0), latest.get('slo_ms', 0.0),
               latest.get('batch_fill', 0.0),
               latest.get('params_version', 0))))
+
+  # Replay section (ISSUE 11): kind='replay' (t2r.replay.v1) windows
+  # from a ReplayService. The one condition a replay fleet pages on: a
+  # shard holding examples that stopped serving draws while the service
+  # as a whole still samples — every learner batch is now biased away
+  # from that shard's experience, silently. Two consecutive windows
+  # must agree (occupancy > 0, shard samples == 0, service samples > 0)
+  # so one small-window multinomial fluke cannot page.
+  replay_records = [r for r in records if r.get('kind') == 'replay']
+  if replay_records:
+    latest = replay_records[-1]
+    stalled_shards = []
+    window_pair = replay_records[-2:]
+    if len(window_pair) == 2 and all(
+        (r.get('samples') or 0) > 0 for r in window_pair):
+      for shard, entry in sorted((latest.get('shards') or {}).items()):
+        stalled = all(
+            ((r.get('shards') or {}).get(shard) or {}).get(
+                'occupancy_examples', 0) > 0
+            and ((r.get('shards') or {}).get(shard) or {}).get(
+                'samples', 0) == 0
+            for r in window_pair)
+        if stalled:
+          stalled_shards.append(shard)
+    if stalled_shards:
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'replay shard{} {} stalled: holding examples but served zero '
+          'draws across the last 2 windows while the service sampled '
+          '{}/s — learner batches are biased away from {} '
+          'experience'.format(
+              's' if len(stalled_shards) > 1 else '',
+              ', '.join(stalled_shards),
+              latest.get('samples_per_sec', 0.0),
+              'their' if len(stalled_shards) > 1 else 'its'),
+          kind='replay_shard_stalled', shards=stalled_shards,
+          samples_per_sec=latest.get('samples_per_sec')))
+    corrupt_by_shard = {
+        shard: entry.get('corrupt', 0)
+        for shard, entry in sorted((latest.get('shards') or {}).items())
+        if entry.get('corrupt', 0) > 0}
+    if corrupt_by_shard:
+      findings.append(_finding(
+          WARNING, 'replay quarantined {:g} corrupt append(s) ({}): a '
+          'writer is shipping damaged records'.format(
+              sum(corrupt_by_shard.values()),
+              ', '.join('shard {} x{:g}'.format(shard, count)
+                        for shard, count in corrupt_by_shard.items())),
+          kind='replay_corrupt_appends', by_shard=corrupt_by_shard))
+    rejected = latest.get('rejected_total') or 0
+    if rejected > 0:
+      findings.append(_finding(
+          WARNING, 'replay admission control shed {:g} sample '
+          'request(s): learners are outrunning this replica'.format(
+              rejected), rejected_total=rejected))
+    if not stalled_shards:
+      findings.append(_finding(
+          INFO, 'replay healthy: {} examples resident ({:.1f} MB, '
+          '{:.0f} B/ex packed), {:.1f} appends/s, {:.1f} samples/s '
+          'across {} shards'.format(
+              latest.get('occupancy_examples', 0),
+              (latest.get('occupancy_bytes') or 0) / 1e6,
+              latest.get('bytes_per_example', 0.0),
+              latest.get('appends_per_sec', 0.0),
+              latest.get('samples_per_sec', 0.0),
+              len(latest.get('shards') or {}))))
 
   # Fleet section (ISSUE 9): federated per-host view. A host whose
   # heartbeat is stale while others advance, or a straggler the fleet

@@ -24,6 +24,14 @@ Sites wired in this codebase (docs/reliability.md):
     docs/observability.md "Fleet observatory") is measurable
     deterministically — the injected-preemption half of ROADMAP item
     4's ``preemption_recovery_seconds`` metric
+  * ``replay.append`` replay service append (replay/service.py) →
+    deterministically CORRUPTS the arriving packed record (truncation),
+    driving the per-shard quarantine-budget path without a bad writer
+    (docs/replay.md)
+  * ``replay.sample`` replay service sample → host-side sleep stalling
+    the draw (``REPLAY_SAMPLE_STALL_SECONDS``), the symptom the
+    learner's pipeline X-ray must catch as ``pipeline_stall`` when it
+    trains from a replay endpoint instead of disk
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -43,10 +51,12 @@ SITE_STEP_NAN = 'step.nan'
 SITE_STEP_SLOW = 'step.slow'
 SITE_DATA_STALL = 'data.stall'
 SITE_HOST_PREEMPT = 'host.preempt'
+SITE_REPLAY_APPEND = 'replay.append'
+SITE_REPLAY_SAMPLE = 'replay.sample'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
                SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL,
-               SITE_HOST_PREEMPT)
+               SITE_HOST_PREEMPT, SITE_REPLAY_APPEND, SITE_REPLAY_SAMPLE)
 
 # Signum stamped into preemption records driven by the injected
 # 'host.preempt' site (no real signal was delivered).
@@ -59,6 +69,9 @@ SLOW_STEP_SECONDS = 0.25
 
 # How long one fired 'data.stall' wedges the host->device feed.
 DATA_STALL_SECONDS = 0.25
+
+# How long one fired 'replay.sample' stalls a replay draw.
+REPLAY_SAMPLE_STALL_SECONDS = 0.25
 
 
 class FaultInjector:
@@ -157,6 +170,14 @@ def stall_data_seconds() -> float:
   injector = _INJECTOR
   if injector is not None and injector.fires(SITE_DATA_STALL):
     return DATA_STALL_SECONDS
+  return 0.0
+
+
+def replay_sample_stall_seconds() -> float:
+  """Seconds the 'replay.sample' site stalls THIS draw; 0.0 when unarmed."""
+  injector = _INJECTOR
+  if injector is not None and injector.fires(SITE_REPLAY_SAMPLE):
+    return REPLAY_SAMPLE_STALL_SECONDS
   return 0.0
 
 

@@ -1,0 +1,156 @@
+"""Learner-side replay feed: sampled megabatches into the trainer.
+
+The last hop of the replay tentpole: batches a client samples from the
+service become ``(features, labels)`` SpecStructs with EXACTLY the
+native loader's layout, so they drop into the existing trainer path
+unchanged — prefetch wraps them (input_generators.prefetch_iterator),
+``PipelinedFeed`` overlaps their transfer, and ``SparseCoefFeed``
+unpacks their packed coefficient groups in the same per-bucket jit it
+uses for disk batches. The train step's input signature is byte-
+identical to reading from local disk; the jit cache cannot tell the
+difference.
+
+The replay hop meters the pipeline X-ray's ``read`` stage (the service
+IS this learner's record source): a stalled replay service shows up as
+a read-gated window and — through the existing watchdog loop — a
+``pipeline_stall`` capture, exactly like a stalled disk.
+
+``ReplayInputGenerator`` is the config-visible binding
+(``--replay_endpoint`` in bin/run_t2r_trainer): an
+AbstractInputGenerator whose iterator samples forever, so
+``max_train_steps`` (not epochs) bounds the run — replay is a stream,
+not a dataset.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional, Union
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data.input_generators import AbstractInputGenerator
+from tensor2robot_tpu.observability.pipeline_xray import StageMeter
+from tensor2robot_tpu.replay.client import LocalReplayClient, ReplayClient
+from tensor2robot_tpu.replay.service import ReplayService, SampleBatch
+from tensor2robot_tpu.specs.struct import SpecStruct
+
+__all__ = ['ReplayBatchIterator', 'ReplayInputGenerator', 'to_spec_structs']
+
+
+def to_spec_structs(batch: SampleBatch):
+  """A sampled batch as (features, labels) SpecStructs."""
+  features = SpecStruct()
+  labels = SpecStruct()
+  for key, value in batch.features.items():
+    features[key] = value
+  for key, value in batch.labels.items():
+    labels[key] = value
+  return features, labels
+
+
+def _batch_nbytes(batch: SampleBatch) -> int:
+  return int(sum(np.asarray(v).nbytes for v in batch.features.values())
+             + sum(np.asarray(v).nbytes for v in batch.labels.values()))
+
+
+def _batch_examples(batch: SampleBatch) -> int:
+  for value in batch.features.values():
+    shape = getattr(value, 'shape', None)
+    if shape and shape[0] > 1:
+      return int(shape[0])
+  return 1 if batch.features else 0
+
+
+class ReplayBatchIterator:
+  """Iterator of (features, labels) SpecStruct batches from a client.
+
+  ``num_batches=None`` iterates forever (the replay stream has no
+  epochs). The first draw ``wait``s for the store to fill (a learner
+  booting before its collectors); later draws fail fast so a DRAINED
+  store surfaces instead of hanging silently.
+  """
+
+  def __init__(self, client, batch_size: int,
+               num_batches: Optional[int] = None,
+               wait_timeout_s: float = 60.0):
+    self._client = client
+    self._batch_size = int(batch_size)
+    self._num_batches = num_batches
+    self._wait_timeout_s = float(wait_timeout_s)
+    self._drawn = 0
+    self._read_meter = StageMeter('read')
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._num_batches is not None and self._drawn >= self._num_batches:
+      raise StopIteration
+    t0 = time.perf_counter()
+    batch = self._client.sample(self._batch_size,
+                                wait=self._drawn == 0,
+                                wait_timeout_s=self._wait_timeout_s)
+    self._read_meter.add(examples=_batch_examples(batch),
+                         nbytes=_batch_nbytes(batch),
+                         busy_s=time.perf_counter() - t0)
+    self._drawn += 1
+    return to_spec_structs(batch)
+
+
+class ReplayInputGenerator(AbstractInputGenerator):
+  """Feeds a trainer from a replay endpoint (or in-process service).
+
+  ``endpoint``: an ``host:port`` / ``http://...`` replay service, an
+  existing client, or a :class:`ReplayService` instance (wrapped in a
+  LocalReplayClient). Batches are validated against the model's specs
+  unless they carry packed coefficient groups (which intentionally
+  mismatch the image specs — the device finishes the decode, same rule
+  as the native loader's coef streams).
+  """
+
+  def __init__(self, endpoint: Union[str, ReplayService, object],
+               batch_size: int = 32,
+               prefetch: int = 2,
+               wait_timeout_s: float = 60.0):
+    super().__init__(batch_size=batch_size, prefetch=prefetch)
+    if isinstance(endpoint, str):
+      self._client = ReplayClient(endpoint)
+    elif isinstance(endpoint, ReplayService):
+      self._client = LocalReplayClient(endpoint)
+    else:
+      self._client = endpoint  # anything with the client API
+    self._wait_timeout_s = float(wait_timeout_s)
+
+  @property
+  def client(self):
+    return self._client
+
+  def _create_iterator(self, mode, num_epochs, shard_index, num_shards,
+                       seed) -> Iterator:
+    # num_epochs bounds BATCHES here (a stream has no epoch); None runs
+    # until the trainer's max_train_steps stops consuming.
+    iterator = ReplayBatchIterator(self._client, self._batch_size,
+                                   num_batches=num_epochs,
+                                   wait_timeout_s=self._wait_timeout_s)
+    if self._feature_spec is None:
+      return iterator
+
+    def _validated():
+      for features, labels in iterator:
+        if any(key.endswith('/pw') or key.endswith('/sd')
+               for key in features):
+          # Packed/sparse coefficient groups intentionally mismatch the
+          # image specs (the device unpacks them) — same skip rule as
+          # NativeBatchedStream._pack's coef branch.
+          yield features, labels
+          continue
+        features = specs_lib.validate_and_pack(
+            self._feature_spec, features, ignore_batch=True)
+        if labels is not None and len(self._label_spec):
+          labels = specs_lib.validate_and_pack(
+              self._label_spec, labels, ignore_batch=True)
+        yield features, labels
+
+    return _validated()

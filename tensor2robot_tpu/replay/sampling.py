@@ -1,0 +1,90 @@
+"""Sampling policies: which resident examples a replay draw returns.
+
+Policies are PURE functions over one shard's priority snapshot: the
+service takes an atomic ``(ids, priorities)`` snapshot under the shard
+lock, the policy draws slot indices against that snapshot, and the
+fetch goes back through the STABLE ids — so a ring slide between the
+snapshot and the fetch can never silently resolve a drawn slot to a
+neighboring record (dead ids are skipped and redrawn instead). The
+service owns the cross-shard split (proportional to occupancy) and the
+assembly. Draws are with replacement — a learner batch may
+legitimately repeat an example when the store is small or priorities
+are concentrated, and with-replacement keeps every draw O(batch)
+instead of O(occupancy).
+
+  * ``uniform`` — every resident example equally likely. Over a
+    reservoir store this makes the sampled distribution uniform over
+    the whole APPEND STREAM (the store is already a uniform subsample);
+    over a ring store it is uniform over the retained window.
+  * ``prioritized`` — P(i) ∝ priority_i ** alpha (Schaul et al.,
+    arXiv 1511.05952): alpha=0 degrades to uniform, alpha=1 is fully
+    proportional. Weights refresh from the store at every draw, so
+    ``update_priorities`` from the learner takes effect on the next
+    batch without any rebuild.
+
+Statistical contracts (draw frequencies within tolerance) are pinned in
+tests/test_replay.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ['SamplePolicy', 'UniformPolicy', 'PrioritizedPolicy',
+           'make_policy', 'POLICIES']
+
+POLICIES = ('uniform', 'prioritized')
+
+
+class SamplePolicy:
+  """Draws ``count`` slot indices against one priority snapshot."""
+
+  name = 'abstract'
+
+  def draw(self, priorities: np.ndarray, count: int,
+           rng: np.random.RandomState) -> List[int]:
+    raise NotImplementedError
+
+
+class UniformPolicy(SamplePolicy):
+
+  name = 'uniform'
+
+  def draw(self, priorities: np.ndarray, count: int,
+           rng: np.random.RandomState) -> List[int]:
+    if priorities.size == 0:
+      return []
+    return rng.randint(0, priorities.size, size=count).tolist()
+
+
+class PrioritizedPolicy(SamplePolicy):
+  """P(i) ∝ priority_i ** alpha over the snapshot handed in per draw."""
+
+  name = 'prioritized'
+
+  def __init__(self, alpha: float = 0.6):
+    if alpha < 0.0:
+      raise ValueError('alpha must be >= 0; got {}.'.format(alpha))
+    self.alpha = float(alpha)
+
+  def draw(self, priorities: np.ndarray, count: int,
+           rng: np.random.RandomState) -> List[int]:
+    if priorities.size == 0:
+      return []
+    weights = np.power(np.maximum(priorities, 0.0), self.alpha)
+    total = float(weights.sum())
+    if total <= 0.0:  # all-zero priorities: degrade to uniform, not a crash
+      return rng.randint(0, priorities.size, size=count).tolist()
+    return rng.choice(priorities.size, size=count, replace=True,
+                      p=weights / total).tolist()
+
+
+def make_policy(name: str, alpha: float = 0.6) -> SamplePolicy:
+  if name == 'uniform':
+    return UniformPolicy()
+  if name == 'prioritized':
+    return PrioritizedPolicy(alpha=alpha)
+  raise ValueError('unknown sampling policy {!r}; have {}.'.format(
+      name, POLICIES))

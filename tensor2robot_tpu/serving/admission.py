@@ -1,51 +1,17 @@
-"""Admission control: shed load instead of growing an unbounded queue.
+"""Back-compat shim: admission control moved to ``serving.batching``.
 
-A serving SLO is a promise about the requests you ACCEPT. Once the
-pending queue saturates, every additional admitted request makes every
-queued request later — the p99 collapses for all callers instead of a
-few callers getting a fast, explicit rejection they can retry against
-another replica. ``AdmissionController`` is that tripwire: requests are
-rejected while queue depth is at ``max_queue_depth``, and every shed
-request is counted in ``serving/rejected`` so capacity planning sees
-exactly how much demand was turned away (ISSUE 8).
+Extracted into the shared import-light
+:mod:`tensor2robot_tpu.serving.batching` module (ISSUE 11 satellite) so
+the replay service's sampling front-end reuses the depth-based shedding
+without importing the policy server. Every historical name keeps
+resolving from here.
 """
 
-from __future__ import annotations
-
-from typing import Optional
-
-from tensor2robot_tpu.observability import get_registry
+from tensor2robot_tpu.serving.batching import (
+    AdmissionController,
+    RequestRejected,
+    SERVING_REJECTED_COUNTER,
+)
 
 __all__ = ['AdmissionController', 'RequestRejected',
            'SERVING_REJECTED_COUNTER']
-
-SERVING_REJECTED_COUNTER = 'serving/rejected'
-
-
-class RequestRejected(RuntimeError):
-  """The server is saturated; the caller should back off / retry
-  elsewhere. Maps to HTTP 503 in the frontend."""
-
-
-class AdmissionController:
-  """Depth-based load shedding with rejection accounting."""
-
-  def __init__(self, max_queue_depth: int, registry=None):
-    if max_queue_depth < 1:
-      raise ValueError('max_queue_depth must be >= 1; got {}.'.format(
-          max_queue_depth))
-    self.max_queue_depth = int(max_queue_depth)
-    registry = registry or get_registry()
-    self._rejected = registry.counter(SERVING_REJECTED_COUNTER)
-
-  def admit(self, queue_depth: int) -> None:
-    """Raises RequestRejected (and counts it) when the queue is full."""
-    if queue_depth >= self.max_queue_depth:
-      self._rejected.inc()
-      raise RequestRejected(
-          'serving queue saturated ({} pending >= max_queue_depth {}); '
-          'request shed'.format(queue_depth, self.max_queue_depth))
-
-  @property
-  def rejected_total(self) -> float:
-    return self._rejected.value

@@ -32,8 +32,11 @@ import os
 # recovery-marker comparisons are genuine cross-process timestamps and
 # carry the annotation, while the recovery PHASES (restore, first step)
 # stay perf_counter durations measured within one process.
+# 'replay' joined with ISSUE 11: sample deadlines, report windows, and
+# client retry/wait budgets are durations; the only timestamps it emits
+# go through TelemetryLogger (already annotated).
 SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
-                    'serving')
+                    'serving', 'replay')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
