@@ -1,0 +1,32 @@
+"""Device-resident vectorized environments (ISSUE 12, the Anakin layer).
+
+The Podracer/Anakin posture (arXiv:2104.06272): environments live ON
+the accelerator as pure functions over explicit state, so one jitted
+acting step advances thousands of env slots — and, because every
+per-slot parameter is just a batch dimension, procedural scenario
+randomization (object/threshold, camera, dynamics) is a *batch axis*,
+not a config fork.
+
+  * `vec_env.py` — the jittable environment contract: ``reset(rng) ->
+    (state, obs)``, ``step(state, action) -> (state, obs, reward, done,
+    info)`` with auto-reset, and the ``VecStep`` bookkeeping invariants
+    the replay writer relies on (pre-reset ``next_obs``, the
+    ``terminal`` vs ``done`` distinction for bootstrap-through-timeout).
+  * `grasping.py` — ``VecGraspingEnv``: the pure-JAX port of the numpy
+    ``SimGraspingEnv`` (research/qtopt/grasping_sim.py), per-slot
+    parity-tested (tests/test_envs.py), with ``ScenarioConfig`` /
+    ``sample_scenarios`` supplying per-slot threshold/dynamics/camera
+    randomization and a difficulty bucket id per slot for the
+    per-scenario success telemetry (docs/rl_loop.md).
+"""
+
+from tensor2robot_tpu.envs.grasping import (
+    ScenarioConfig,
+    Scenarios,
+    VecGraspingEnv,
+    sample_scenarios,
+)
+from tensor2robot_tpu.envs.vec_env import VecEnv, VecStep
+
+__all__ = ['VecEnv', 'VecStep', 'VecGraspingEnv', 'ScenarioConfig',
+           'Scenarios', 'sample_scenarios']

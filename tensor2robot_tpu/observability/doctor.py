@@ -125,11 +125,12 @@ def diagnose(model_dir: str,
     if candidate and (beat is None or
                       candidate.get('time', 0) > beat.get('time', 0)):
       beat = candidate
-  # 'serving_stop'/'replay_stop' count as orderly ends: a PolicyServer
-  # or ReplayService that closed cleanly stops heartbeating by design,
-  # which is not a wedged process.
+  # 'serving_stop'/'replay_stop'/'rl_stop' count as orderly ends: a
+  # PolicyServer, ReplayService or RL loop that closed cleanly stops
+  # heartbeating by design, which is not a wedged process.
   run_ended = bool(records) and records[-1].get('kind') in (
-      'run_end', 'run_abort', 'preempted', 'serving_stop', 'replay_stop')
+      'run_end', 'run_abort', 'preempted', 'serving_stop', 'replay_stop',
+      'rl_stop')
   if run_ended and beat is not None:
     findings.append(_finding(
         INFO, 'run finished ({}); heartbeat age not meaningful'.format(
@@ -363,6 +364,76 @@ def diagnose(model_dir: str,
               latest.get('appends_per_sec', 0.0),
               latest.get('samples_per_sec', 0.0),
               len(latest.get('shards') or {}))))
+
+  # RL section (ISSUE 12): kind='rl' (t2r.rl.v1) windows from the
+  # actor<->learner loop. The page-worthy condition is ONE SIDE of the
+  # closed loop dying while the other runs on: an actor that stopped
+  # stepping starves the learner of fresh experience (it silently
+  # overfits the resident buffer); a learner that stopped stepping
+  # freezes the policy while collection burns compute. Two consecutive
+  # windows must agree, the side must have STARTED in an earlier window
+  # — a learner still waiting for its first replay batch is a boot
+  # order, not a stall — and the side must not have FINISHED its
+  # configured target (the records' actor_done/learner_done flags): a
+  # learner that completed --learner_steps while the actor collects on
+  # is a documented healthy mode, not a page.
+  rl_records = [r for r in records if r.get('kind') == 'rl']
+  if rl_records:
+    latest = rl_records[-1]
+    window_pair = rl_records[-2:]
+    actor_started = any((r.get('actor_steps') or 0) > 0
+                        for r in rl_records)
+    learner_started = any((r.get('learner_steps') or 0) > 0
+                          for r in rl_records)
+    stalled_side = None
+    if len(window_pair) == 2:
+      if actor_started and all(
+          (r.get('actor_steps') or 0) == 0
+          and (r.get('learner_steps') or 0) > 0
+          and not r.get('actor_done') for r in window_pair):
+        stalled_side = 'actor'
+      elif learner_started and all(
+          (r.get('learner_steps') or 0) == 0
+          and (r.get('actor_steps') or 0) > 0
+          and not r.get('learner_done') for r in window_pair):
+        stalled_side = 'learner'
+    if stalled_side is not None:
+      other = 'learner' if stalled_side == 'actor' else 'actor'
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'rl loop: the {} side stalled — zero {} steps across the last '
+          '2 windows while the {} kept stepping ({})'.format(
+              stalled_side, stalled_side, other,
+              'fresh experience has stopped flowing; the learner is '
+              'training on a frozen buffer' if stalled_side == 'actor'
+              else 'the policy is frozen while collection burns '
+              'compute'),
+          kind='rl_{}_stalled'.format(stalled_side), side=stalled_side,
+          actor_steps=latest.get('actor_steps'),
+          learner_steps=latest.get('learner_steps')))
+    cache = latest.get('act_jit_cache')
+    if cache is not None and cache > 1.0:
+      findings.append(_finding(
+          WARNING, 'rl loop: acting path compiled {:g} executables — a '
+          'signature-unstable input reached the jitted acting step '
+          '(expected exactly 1; see rl/loop.py make_act_step)'.format(
+              cache), kind='rl_act_recompile', act_jit_cache=cache))
+    if stalled_side is None:
+      spread = latest.get('scenario_success_spread')
+      findings.append(_finding(
+          INFO, 'rl loop@{}: {:.1f} ep/s ({:.0f} env steps/s), success '
+          '{:.0%} cumulative, actor v{} of learner v{} ({} swaps{}){}'
+          .format(
+              latest.get('step'), latest.get('episodes_per_sec', 0.0),
+              latest.get('env_steps_per_sec', 0.0),
+              latest.get('success_rate_cumulative', 0.0),
+              latest.get('actor_version', 0),
+              latest.get('learner_version', 0),
+              latest.get('swaps', 0),
+              ', {} dropped'.format(latest['dropped_swaps'])
+              if latest.get('dropped_swaps') else '',
+              '' if spread is None else
+              ', scenario spread {:.0%}'.format(spread))))
 
   # Fleet section (ISSUE 9): federated per-host view. A host whose
   # heartbeat is stale while others advance, or a straggler the fleet

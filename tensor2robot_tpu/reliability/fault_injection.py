@@ -32,6 +32,15 @@ Sites wired in this codebase (docs/reliability.md):
     the draw (``REPLAY_SAMPLE_STALL_SECONDS``), the symptom the
     learner's pipeline X-ray must catch as ``pipeline_stall`` when it
     trains from a replay endpoint instead of disk
+  * ``actor.stall``  RL loop acting step (rl/loop.py) → host-side sleep
+    inflating the acting step (``ACTOR_STALL_SECONDS``), the symptom
+    the loop's own watchdog must catch as a step-time regression and
+    turn into exactly one budgeted capture — while the concurrent
+    learner keeps stepping (docs/rl_loop.md)
+  * ``learner.swap`` RL loop weight poll (rl/loop.py) → DROPS one
+    actor-side weight-swap poll (the snapshot is not adopted); the
+    next poll retries, so the loop converges anyway — the protocol's
+    at-least-once claim, driven deterministically
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -53,10 +62,13 @@ SITE_DATA_STALL = 'data.stall'
 SITE_HOST_PREEMPT = 'host.preempt'
 SITE_REPLAY_APPEND = 'replay.append'
 SITE_REPLAY_SAMPLE = 'replay.sample'
+SITE_ACTOR_STALL = 'actor.stall'
+SITE_LEARNER_SWAP = 'learner.swap'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
                SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL,
-               SITE_HOST_PREEMPT, SITE_REPLAY_APPEND, SITE_REPLAY_SAMPLE)
+               SITE_HOST_PREEMPT, SITE_REPLAY_APPEND, SITE_REPLAY_SAMPLE,
+               SITE_ACTOR_STALL, SITE_LEARNER_SWAP)
 
 # Signum stamped into preemption records driven by the injected
 # 'host.preempt' site (no real signal was delivered).
@@ -72,6 +84,9 @@ DATA_STALL_SECONDS = 0.25
 
 # How long one fired 'replay.sample' stalls a replay draw.
 REPLAY_SAMPLE_STALL_SECONDS = 0.25
+
+# How long one fired 'actor.stall' wedges the RL loop's acting step.
+ACTOR_STALL_SECONDS = 0.25
 
 
 class FaultInjector:
@@ -178,6 +193,14 @@ def replay_sample_stall_seconds() -> float:
   injector = _INJECTOR
   if injector is not None and injector.fires(SITE_REPLAY_SAMPLE):
     return REPLAY_SAMPLE_STALL_SECONDS
+  return 0.0
+
+
+def actor_stall_seconds() -> float:
+  """Seconds the 'actor.stall' site wedges THIS acting step; 0.0 unarmed."""
+  injector = _INJECTOR
+  if injector is not None and injector.fires(SITE_ACTOR_STALL):
+    return ACTOR_STALL_SECONDS
   return 0.0
 
 

@@ -57,6 +57,26 @@ H_MAX = 1.6
 GAMMA = 0.8
 
 
+def action_dim_offset(name: str) -> int:
+  """Start offset of one ACTION_DIM_LAYOUT block in the flat CEM vector."""
+  offset = 0
+  for key, size in ACTION_DIM_LAYOUT:
+    if key == name:
+      return offset
+    offset += size
+  raise KeyError(name)
+
+
+# Flat CEM-action indices, derived from the layout so a reordering of
+# ACTION_DIM_LAYOUT cannot silently desynchronize the numpy env, the
+# vectorized env (envs/grasping.py) and the actor's exploration
+# (rl/loop.py) — all three import these.
+WV_Z_INDEX = action_dim_offset('world_vector') + 2  # world_vector z
+CLOSE_INDEX = action_dim_offset('close_gripper')
+OPEN_INDEX = action_dim_offset('open_gripper')
+TERMINATE_INDEX = action_dim_offset('terminate_episode')
+
+
 def steps_to_grasp(h: float, threshold: float = THRESHOLD,
                    descent_scale: float = DESCENT_SCALE) -> int:
   return int(math.ceil(max(0.0, h - threshold) / descent_scale))
@@ -69,9 +89,21 @@ def optimal_value(h: float, gamma: float = GAMMA, **kwargs) -> float:
 def _action_vector(wv_z: float = 0.0, close: float = 0.0) -> np.ndarray:
   """8-dim CEM action per ACTION_DIM_LAYOUT with the used dims set."""
   action = np.zeros((8,), np.float32)
-  action[2] = wv_z        # world_vector z
-  action[5] = close       # close_gripper
+  action[WV_Z_INDEX] = wv_z
+  action[CLOSE_INDEX] = close
   return action
+
+
+def gradient_background(height: int, width: int) -> np.ndarray:
+  """The camera frame's deterministic background, float32 [H, W, 3].
+
+  Shared with the vectorized JAX port (envs/grasping.py): both envs
+  render over the SAME host-computed constant, so the per-pixel parity
+  contract reduces to the (pure, float32) scene drawing."""
+  x = np.linspace(0, 1, width)
+  y = np.linspace(0, 1, height)
+  return (np.outer(y, x)[..., None]
+          * np.array([140, 160, 180])).astype(np.float32)
 
 
 class SimGraspingEnv:
@@ -95,12 +127,14 @@ class SimGraspingEnv:
                descent_scale: float = DESCENT_SCALE,
                safe_region: Optional[Tuple[Tuple[int, int],
                                            Tuple[int, int]]] = None,
+               noise_scale: float = 4.0,
                seed: Optional[int] = None):
     self._height = height
     self._width = width
     self._episode_length = episode_length
     self._threshold = threshold
     self._descent_scale = descent_scale
+    self._noise_scale = float(noise_scale)
     if safe_region is None:
       if (height, width) == (512, 640):
         safe_region = ((40, 472), (168, 472))
@@ -120,10 +154,7 @@ class SimGraspingEnv:
     """Camera-like frame: gradient + noise, object block, gripper at h."""
     height, width = self._height, self._width
     if self._background is None:
-      x = np.linspace(0, 1, width)
-      y = np.linspace(0, 1, height)
-      self._background = (np.outer(y, x)[..., None] *
-                          np.array([140, 160, 180])).astype(np.float32)
+      self._background = gradient_background(height, width)
     img = self._background.copy()
     (y0, y1), (x0, x1) = self._safe
     band_h, band_w = y1 - y0, x1 - x0
@@ -138,7 +169,8 @@ class SimGraspingEnv:
     grip_y = max(y0, grip_y)
     img[grip_y:grip_y + block, cx - block // 2:cx + block // 2] = (
         40, 200, 60)
-    img = img + self._rng.randn(height, width, 1) * 4
+    if self._noise_scale:
+      img = img + self._rng.randn(height, width, 1) * self._noise_scale
     return np.clip(img, 0, 255).astype(np.uint8)
 
   def _obs(self) -> dict:
@@ -153,12 +185,12 @@ class SimGraspingEnv:
 
   def step(self, action):
     action = np.asarray(action, np.float32).ravel()
-    close = float(action[5]) > 0.5
+    close = float(action[CLOSE_INDEX]) > 0.5
     self._t += 1
     if close:
       reward = 1.0 if self._h <= self._threshold else 0.0
       return self._obs(), reward, True, {'terminal': True}
-    wv_z = float(np.clip(action[2], -1.0, 1.0))
+    wv_z = float(np.clip(action[WV_Z_INDEX], -1.0, 1.0))
     self._h = float(np.clip(self._h - self._descent_scale * wv_z,
                             0.0, H_MAX))
     timeout = self._t >= self._episode_length
@@ -191,9 +223,9 @@ class SimGraspingRandomPolicy:
   def sample_action(self, obs, explore_prob):
     del obs, explore_prob
     action = self._rng.uniform(-1.0, 1.0, 8).astype(np.float32)
-    action[5] = float(self._rng.rand() < self._close_prob)
-    action[6] = float(self._rng.rand() < 0.5)
-    action[7] = 0.0
+    action[CLOSE_INDEX] = float(self._rng.rand() < self._close_prob)
+    action[OPEN_INDEX] = float(self._rng.rand() < 0.5)
+    action[TERMINATE_INDEX] = 0.0
     return action, None
 
 

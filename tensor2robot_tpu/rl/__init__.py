@@ -1,4 +1,8 @@
-"""RL collect/eval: run policies in environments, write replay TFRecords."""
+"""RL: collect/eval loops, off-policy Bellman training, and the closed
+device-resident actor<->learner loop (rl/loop.py, docs/rl_loop.md).
+
+The ``t2r.rl.v1`` telemetry vocabulary lives jax-free in
+``observability/rl_metrics.py`` (this package imports jax at init)."""
 
 from tensor2robot_tpu.rl.run_env import run_env
 from tensor2robot_tpu.rl.collect_eval import collect_eval_loop
@@ -8,7 +12,13 @@ from tensor2robot_tpu.rl.offpolicy import (
     pairwise_ranking_accuracy,
     ranking_accuracy_from_scores,
 )
+from tensor2robot_tpu.rl.loop import (
+    RLLoop,
+    RLLoopConfig,
+    build_grasping_loop,
+)
 
 __all__ = ['collect_eval_loop', 'run_env', 'BellmanQTOptTrainer',
            'concat_ranking_pairs', 'pairwise_ranking_accuracy',
-           'ranking_accuracy_from_scores']
+           'ranking_accuracy_from_scores', 'RLLoop', 'RLLoopConfig',
+           'build_grasping_loop']

@@ -35,8 +35,13 @@ import os
 # 'replay' joined with ISSUE 11: sample deadlines, report windows, and
 # client retry/wait budgets are durations; the only timestamps it emits
 # go through TelemetryLogger (already annotated).
+# 'envs' + 'rl' joined with ISSUE 12: the acting-step timing, report
+# windows, swap-poll cadence and run deadlines of the closed
+# actor<->learner loop (rl/loop.py) are all durations — a wall-clock
+# jump must not fabricate an acting-step regression or end a run early;
+# the vectorized envs are pure functions and must stay clock-free.
 SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
-                    'serving', 'replay')
+                    'serving', 'replay', 'envs', 'rl')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
