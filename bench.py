@@ -1505,6 +1505,67 @@ def _bench_rl_loop(on_tpu: bool):
   }
 
 
+def _bench_coldstart(on_tpu: bool):
+  """Cold-start axis (ISSUE 13): cold vs warm process start through the
+  unified CompiledArtifact store.
+
+  Two SUBPROCESS runs of ``tensor2robot_tpu.compile.coldstart`` sharing
+  one artifact store: the first (cold, empty store) compiles and
+  persists; the second (warm) is a TRUE process cold start — fresh
+  interpreter, fresh jax, nothing but the on-disk artifacts — and must
+  deserialize everything: its ``jax/compiles`` delta across artifact
+  bind + first executed train step is published as
+  ``coldstart_warm_compiles`` and must be 0. The subprocess discipline
+  is the point: an in-process warm leg would be warmed by jax's
+  per-object caches, which is exactly the measurement error this axis
+  exists to kill. Publishes COLDSTART_BENCH_KEYS
+  (compile/artifact.py, schema-locked by bin/check_artifact_doctor).
+  """
+  import subprocess
+  import sys
+
+  tmp = tempfile.mkdtemp()
+  try:
+    cache_path = os.path.join(tmp, 'tuning_cache.json')
+
+    def leg(name):
+      # The REAL flagship critic (19-layer Grasping44 at camera
+      # resolution, batch 4): its multi-second step compile is what a
+      # production cold start pays, so the warm delta is unmistakable.
+      cmd = [sys.executable, '-m', 'tensor2robot_tpu.compile.coldstart',
+             '--cache_path', cache_path, '--model', 'grasping44',
+             '--batch_size', '4',
+             '--model_dir', os.path.join(tmp, name)]
+      result = subprocess.run(
+          cmd, capture_output=True, text=True, timeout=900,
+          cwd=os.path.dirname(os.path.abspath(__file__)))
+      if result.returncode != 0:
+        raise RuntimeError('coldstart {} leg failed: {}'.format(
+            name, (result.stderr or result.stdout)[-500:]))
+      return json.loads(result.stdout.strip().splitlines()[-1])
+
+    cold = leg('cold')
+    warm = leg('warm')
+    return {
+        'coldstart_time_to_first_step_s_cold':
+            cold['time_to_first_step_s'],
+        'coldstart_time_to_first_step_s_warm':
+            warm['time_to_first_step_s'],
+        'coldstart_warm_vs_cold': round(
+            warm['time_to_first_step_s']
+            / max(cold['time_to_first_step_s'], 1e-9), 4),
+        'coldstart_warm_compiles': warm['step_compiles'],
+        'coldstart_serving_time_to_ready_warm_s':
+            warm['serving_time_to_ready_s'],
+        'coldstart_artifact_hits': warm['artifact_hits'],
+        'coldstart_artifact_misses': warm['artifact_misses'],
+    }
+  finally:
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_serving(model, mesh, on_tpu: bool,
                    batch: int = 8,
                    cem_samples: int = 64,
@@ -2137,6 +2198,21 @@ def main():
   except Exception as e:  # noqa: BLE001
     out['rl_episodes_per_sec'] = -1.0
     out['rl_error'] = repr(e)[:200]
+
+  try:
+    # Cold-start axis (ISSUE 13): cold vs warm process start through
+    # the unified CompiledArtifact store, both legs in subprocesses —
+    # coldstart_warm_compiles is the zero-compile contract as a number.
+    out.update(_bench_coldstart(on_tpu))
+    from tensor2robot_tpu.compile.artifact import COLDSTART_BENCH_KEYS
+    coldstart_missing = [key for key in COLDSTART_BENCH_KEYS
+                         if key not in out]
+    if coldstart_missing:
+      out['coldstart_schema_missing'] = coldstart_missing
+  except Exception as e:  # noqa: BLE001
+    out['coldstart_time_to_first_step_s_warm'] = -1.0
+    out['coldstart_warm_compiles'] = -1
+    out['coldstart_error'] = repr(e)[:200]
 
   try:
     maml_ms, maml_spread = _bench_maml_inner_step(mesh)

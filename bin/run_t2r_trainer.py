@@ -29,6 +29,16 @@ def main(argv=None):
                            'rate (docs/replay.md).')
   parser.add_argument('--replay_batch_size', type=int, default=32,
                       help='Sampled megabatch size with --replay_endpoint.')
+  parser.add_argument('--use_compiled_artifacts', action='store_true',
+                      help='Cold-start the train step from the unified '
+                           'CompiledArtifact store (docs/performance.md '
+                           '"Cold start"): a warm start deserializes the '
+                           'persisted executable and the first step '
+                           'executes without an XLA compile.')
+  parser.add_argument('--artifact_workload', default=None,
+                      help='Store workload name with '
+                           '--use_compiled_artifacts (default: derived '
+                           'from the tuned_config string or model class).')
   args = parser.parse_args(argv)
 
   from tensor2robot_tpu import config
@@ -44,6 +54,10 @@ def main(argv=None):
 
     overrides['input_generator_train'] = ReplayInputGenerator(
         args.replay_endpoint, batch_size=args.replay_batch_size)
+  if args.use_compiled_artifacts:
+    overrides['use_compiled_artifacts'] = True
+    if args.artifact_workload:
+      overrides['artifact_workload'] = args.artifact_workload
   results = train_eval_model(**overrides)
   metrics = results.get('eval_metrics') if isinstance(results, dict) else None
   if metrics:

@@ -435,6 +435,50 @@ def diagnose(model_dir: str,
               '' if spread is None else
               ', scenario spread {:.0%}'.format(spread))))
 
+  # Compile section (ISSUE 13): kind='compile' records from the unified
+  # CompiledArtifact store, plus fingerprint-drift anomalies. Drift —
+  # the same artifact key (workload, shapes, chip, jax version, config)
+  # compiling to a DIFFERENT post-optimization program — means the
+  # persisted-executable contract is broken for that workload: page
+  # while live, evidence after the run ends.
+  compile_records = [r for r in records if r.get('kind') == 'compile']
+  drift_records = [r for r in records
+                   if r.get('kind') == 'anomaly'
+                   and r.get('anomaly') == 'fingerprint_drift']
+  if drift_records:
+    # One finding PER drifted workload — a run where two workloads
+    # drift must name both, or the operator investigates only the last.
+    drift_by_workload: Dict[str, int] = {}
+    for record in drift_records:
+      workload = (record.get('detail') or {}).get('workload') or \
+          'unknown'
+      drift_by_workload[workload] = drift_by_workload.get(workload,
+                                                          0) + 1
+    for workload, count in sorted(drift_by_workload.items()):
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'compile: post-optimization fingerprint drifted for workload '
+          '{!r} ({} event(s)) — the same artifact key (shapes/chip/jax/'
+          'config unchanged) now compiles to a different program; the '
+          'toolchain moved under a pinned version string, or lowering '
+          'went nondeterministic'.format(workload, count),
+          kind='fingerprint_drift', workload=workload, count=count))
+  if compile_records:
+    hits = sum(1 for r in compile_records if r.get('outcome') == 'hit')
+    misses = len(compile_records) - hits
+    compile_ms = sum(float(r.get('compile_ms') or 0.0)
+                     for r in compile_records)
+    workloads = sorted({str(r.get('workload'))
+                        for r in compile_records})
+    findings.append(_finding(
+        INFO, 'compile: {} artifact load(s) across {} workload(s) — '
+        '{} deserialized (zero-compile), {} compiled ({:.0f} ms '
+        'compiling)'.format(
+            len(compile_records), len(workloads), hits, misses,
+            compile_ms),
+        hits=hits, misses=misses, compile_ms_total=compile_ms,
+        workloads=workloads))
+
   # Fleet section (ISSUE 9): federated per-host view. A host whose
   # heartbeat is stale while others advance, or a straggler the fleet
   # has not recovered from, halts/gates the whole mesh: CRITICAL while

@@ -96,6 +96,16 @@ class RLLoopConfig:
     auto_profile / profile_window_steps / max_captures: the loop's own
       budgeted capture loop (an armed ``actor.stall`` claims exactly
       one window).
+    artifact_workload: when set, the acting step cold-starts through
+      the unified ``CompiledArtifact`` store (tensor2robot_tpu/compile,
+      docs/performance.md "Cold start") under this workload name: a
+      warm start DESERIALIZES the persisted acting executable — the
+      first acting step executes without an XLA compile — and a miss
+      compiles once and persists for the next process. The key carries
+      the lowered-program hash, so a changed env/CEM config is a miss,
+      never a wrong load.
+    artifact_cache_path: the store location (default: the process
+      tuning cache's directory).
     seed: all loop-side randomness.
   """
 
@@ -115,6 +125,8 @@ class RLLoopConfig:
   auto_profile: bool = False
   profile_window_steps: int = 2
   max_captures: int = 1
+  artifact_workload: Optional[str] = None
+  artifact_cache_path: Optional[str] = None
   seed: int = 0
 
 
@@ -347,6 +359,7 @@ class RLLoop:
         num_elites=cfg.num_elites, explore_prob=cfg.explore_prob,
         explore_close_prob=cfg.explore_close_prob,
         out_sharding=self._env_sharding)
+    self._act_loaded = None  # CompiledArtifact when artifact_workload set
     self._greedy_act = None  # built lazily by measure_success
     self.watchdog = Watchdog(WatchdogConfig(), registry=self._registry)
     self.profiler = AutoProfiler(
@@ -553,7 +566,40 @@ class RLLoop:
           self._bucket_successes.get(bucket, 0) + 1
       self._success_counters.series(str(bucket)).inc()
 
+  def _bind_act_artifact(self, env_state, obs, base_rng) -> None:
+    """Acting-step cold start through the CompiledArtifact store.
+
+    Called once per process, right after the env buffers are committed
+    to the carry's pinned sharding — the example args ARE the
+    steady-state call's (variables, env_state, obs, rng), so the loaded
+    executable serves every acting step. Best-effort: any store failure
+    degrades to the stock jit path (one compile at the first call).
+    """
+    try:
+      from tensor2robot_tpu.compile import artifact as artifact_lib
+
+      self._act_loaded = artifact_lib.load_or_compile(
+          self.config.artifact_workload, self._act,
+          (self._actor_variables, env_state, obs,
+           jax.random.fold_in(base_rng, 0)),
+          cache_path=self.config.artifact_cache_path,
+          telemetry=self.telemetry, program_key=True)
+      log_warning('Acting step bound from CompiledArtifact store: %s '
+                  '(%s).', self.config.artifact_workload,
+                  'deserialized' if self._act_loaded.from_cache
+                  else 'compiled + persisted')
+    except Exception as e:  # noqa: BLE001 — never kill the loop
+      log_warning('Acting-step artifact bind failed (%s); using the '
+                  'stock jit path.', e)
+      self._act_loaded = None
+
   def _sample_act_cache(self) -> float:
+    if self._act_loaded is not None:
+      # AOT path: exactly one executable exists by construction and the
+      # jit cache stays empty — report the healthy 1 (same convention
+      # as Trainer._sample_recompiles).
+      self._act_cache_gauge.set(1.0)
+      return 1.0
     try:
       size = float(self._act._cache_size())  # noqa: SLF001 — same probe
       # as Trainer._sample_recompiles; absent on some jax versions.
@@ -686,6 +732,10 @@ class RLLoop:
     base_rng = jax.random.PRNGKey(cfg.seed)
     env_state, obs = self._place_env(
         *self.env.reset(jax.random.fold_in(base_rng, 2**16)))
+    if cfg.artifact_workload and self._act_loaded is None:
+      self._bind_act_artifact(env_state, obs, base_rng)
+    act_fn = (self._act_loaded.executable
+              if self._act_loaded is not None else self._act)
     buffers: List[List[Dict[str, np.ndarray]]] = [
         [] for _ in range(self.env.num_envs)]
     step_i = 0
@@ -712,7 +762,7 @@ class RLLoop:
         if stall_s > 0.0:
           time.sleep(stall_s)
         t0 = time.perf_counter()
-        env_state, obs, transition = self._act(
+        env_state, obs, transition = act_fn(
             self._actor_variables, env_state, obs,
             jax.random.fold_in(base_rng, step_i))
         fetched = jax.device_get(transition)

@@ -1,41 +1,49 @@
 """AOT serving executables: compile at startup, never at request time.
 
-The serving half of the ``CompiledArtifact`` story (ROADMAP item 5,
-first slice): the server's batch program is lowered and compiled ONCE at
-startup — under the ``tuning/`` cache winner's compiler options for this
-exact workload+shapes+chip key, so the server runs the same config it
-was tuned under — and the compiled executable is **serialized to disk
-alongside the cache entry**. A warm restart deserializes it and skips
-even the startup compile; a cold start (or a stale artifact: different
-jax version, different chip, changed shapes) falls back to one AOT
-compile and re-persists. Either way there is NOTHING left to compile by
-the time the first request arrives, which the bench asserts via the
-``jax/compiles`` counter (``serving.request_time_compiles == 0``).
+Since ISSUE 13 this module is a THIN ADAPTER over the unified
+``tensor2robot_tpu/compile`` artifact pipeline (ROADMAP item 5 — this
+file was its first slice, now generalized): the server's batch program
+resolves through the same ``CompiledArtifact`` store the trainer, the
+autotuner sweep, the RL acting step, and forensics use. What stays
+serving-specific:
 
-Artifact files are atomic (tmp + rename), self-describing, and advisory:
-any failure to load — corrupt pickle, jaxlib that cannot deserialize,
-schema drift — degrades to the startup compile, never to a dead server.
+  * the tuning-cache WINNER resolution happens here (through the shared
+    ``resolve_cache_winner`` guard — winners carrying model overrides
+    or ``winner_ok=False`` placeholder entries are refused, never
+    half-applied), so a re-swept cache whose winner moved forces one
+    fresh startup compile under the new config instead of silently
+    serving the old program;
+  * the cache entry is stamped with the persisted executable's path
+    (``'serialized_executable'``), keeping the tuning evidence and the
+    program it picked in one place;
+  * artifacts are keyed WITHOUT the lowered-program sha
+    (``program_key=False``): serving workload names pin the program
+    (``serving_qtopt_cem_b8``), and a warm restart must deserialize
+    without paying even the trace.
+
+The contract is unchanged: a warm restart deserializes and compiles
+NOTHING; a cold start (or a stale/corrupt artifact) falls back to one
+AOT compile and re-persists; either way there is nothing left to
+compile when the first request arrives (``serving.request_time_compiles
+== 0`` in the bench).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import hashlib
-import os
-import pickle
-import tempfile
 from typing import Any, Optional
 
+from tensor2robot_tpu.compile import artifact as artifact_lib
 from tensor2robot_tpu.reliability.logutil import log_warning
-from tensor2robot_tpu.tuning import autotuner
 from tensor2robot_tpu.tuning import cache as cache_lib
-from tensor2robot_tpu.tuning import search_space
 
 __all__ = ['ServingExecutable', 'artifact_path_for_key', 'load_or_compile',
            'ARTIFACT_SCHEMA', 'ARTIFACT_DIRNAME']
 
-ARTIFACT_SCHEMA = 't2r.serving_artifact.v1'
-ARTIFACT_DIRNAME = 'artifacts'
+# The unified schema/dirname (kept exported: bin/t2r_serve and tests
+# name them through this module).
+ARTIFACT_SCHEMA = artifact_lib.ARTIFACT_SCHEMA
+ARTIFACT_DIRNAME = artifact_lib.ARTIFACT_DIRNAME
 
 
 @dataclasses.dataclass
@@ -57,105 +65,12 @@ class ServingExecutable:
   path: str
 
 
-def artifact_path_for_key(cache_path: str, key: str) -> str:
-  """``<cache dir>/artifacts/<sha1(key)>.pkl`` — alongside the cache
-  file, so one directory carries both the tuning evidence and the
-  executable it picked."""
-  digest = hashlib.sha1(key.encode('utf-8')).hexdigest()[:20]
-  return os.path.join(os.path.dirname(cache_path) or '.',
-                      ARTIFACT_DIRNAME, digest + '.pkl')
-
-
-def _winner_for_entry(entry) -> Optional[search_space.CompileConfig]:
-  """The applicable tuning winner, or None (baseline compile).
-
-  Mirrors the trainer's refusal to half-apply: a winner carrying
-  ``model_overrides`` changed the MODEL the sweep measured; compiler
-  options alone would attribute a config that never ran.
-  """
-  if not entry or not entry.get('winner_ok', True):
-    return None
-  try:
-    winner = search_space.CompileConfig.from_dict(entry['winner'])
-  except (KeyError, TypeError, ValueError):
-    return None
-  if winner.model_overrides:
-    return None
-  return winner
-
-
-def _try_load(path: str, key: str, device_kind: str,
-              expected_config_id: str):
-  """Deserializes a persisted executable; None on any mismatch/corruption.
-
-  ``expected_config_id`` is the CURRENT tuning-cache winner for this
-  key: an artifact compiled under a different config is stale — a
-  re-swept cache whose winner moved must trigger a fresh startup compile
-  under the new winner, not silently keep serving the old program.
-  """
-  if not os.path.exists(path):
-    return None
-  try:
-    with open(path, 'rb') as f:
-      payload = pickle.load(f)
-    if (payload.get('schema') != ARTIFACT_SCHEMA
-        or payload.get('key') != key
-        or payload.get('device_kind') != device_kind):
-      return None
-    if str(payload.get('config_id', 'baseline')) != expected_config_id:
-      log_warning('Serving artifact %s was compiled under config %r but '
-                  'the tuning cache now names %r; recompiling.', path,
-                  payload.get('config_id'), expected_config_id)
-      return None
-    import jax
-    from jax.experimental import serialize_executable
-
-    if payload.get('jax_version') != jax.__version__:
-      return None
-    return serialize_executable.deserialize_and_load(
-        payload['serialized'], payload['in_tree'], payload['out_tree'])
-  except Exception as e:  # noqa: BLE001 — stale/corrupt artifact
-    log_warning('Serving artifact %s failed to load (%s); falling back '
-                'to a startup compile.', path, e)
-    return None
-
-
-def _persist(path: str, key: str, workload: str, device_kind: str,
-             config_id: str, compiled) -> bool:
-  """Serializes ``compiled`` to ``path`` atomically; False if the
-  backend/executable does not support serialization."""
-  try:
-    from jax.experimental import serialize_executable
-    import jax
-
-    serialized, in_tree, out_tree = serialize_executable.serialize(compiled)
-    payload = {
-        'schema': ARTIFACT_SCHEMA,
-        'key': key,
-        'workload': workload,
-        'device_kind': device_kind,
-        'jax_version': jax.__version__,
-        'config_id': config_id,
-        'serialized': serialized,
-        'in_tree': in_tree,
-        'out_tree': out_tree,
-    }
-    directory = os.path.dirname(path) or '.'
-    os.makedirs(directory, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix='.tmp')
-    try:
-      with os.fdopen(fd, 'wb') as f:
-        pickle.dump(payload, f)
-      os.replace(tmp, path)
-    finally:
-      if os.path.exists(tmp):
-        os.unlink(tmp)
-    return True
-  except Exception as e:  # noqa: BLE001 — e.g. backend without PJRT
-    # serialization; the server still starts, it just cold-compiles.
-    log_warning('Could not persist serving executable for %s: %s',
-                workload, e)
-    return False
+def artifact_path_for_key(cache_path: str, key: str,
+                          config_id: str = 'baseline') -> str:
+  """Where the unified store keeps this key's executable — alongside
+  the cache file, so one directory carries both the tuning evidence and
+  the executable it picked."""
+  return artifact_lib.ArtifactStore(cache_path).path_for(key, config_id)
 
 
 def load_or_compile(workload: str,
@@ -163,7 +78,8 @@ def load_or_compile(workload: str,
                     example_args,
                     cache: Optional[cache_lib.ConfigCache] = None,
                     cache_path: Optional[str] = None,
-                    persist: bool = True) -> ServingExecutable:
+                    persist: bool = True,
+                    telemetry: Optional[Any] = None) -> ServingExecutable:
   """The server-startup path: deserialize, else AOT-compile + persist.
 
   Args:
@@ -175,6 +91,7 @@ def load_or_compile(workload: str,
       defaults to the process tuning cache.
     persist: serialize a freshly-compiled executable back to disk (and
       stamp its path into the cache entry when one exists).
+    telemetry: optional TelemetryLogger for ``kind='compile'`` records.
   """
   import jax
 
@@ -183,30 +100,43 @@ def load_or_compile(workload: str,
   device_kind = getattr(jax.devices()[0], 'device_kind', 'unknown')
   signature = cache_lib.abstract_signature(example_args)
   key = cache_lib.cache_key(workload, signature, device_kind)
-  path = artifact_path_for_key(cache.path, key)
 
-  # Resolve the CURRENT winner first: a persisted executable is only
-  # valid if it was compiled under the config the cache names today.
+  # Resolve the CURRENT winner first, through the shared guard: a
+  # persisted executable is only valid under the config the cache names
+  # today, and a winner the trainer would refuse (model overrides,
+  # winner_ok=False) is refused here identically.
   entry = cache.lookup(key)
-  winner = _winner_for_entry(entry)
-  config_id = winner.config_id if winner is not None else 'baseline'
+  winner, _ = artifact_lib.resolve_cache_winner(entry)
 
-  executable = _try_load(path, key, device_kind,
-                         expected_config_id=config_id)
-  if executable is not None:
-    return ServingExecutable(executable=executable, key=key,
-                             workload=workload, config_id=config_id,
-                             from_cache=True, path=path)
-
-  compiled = autotuner.compile_with_config(jitted, example_args, winner)
-  persisted = persist and _persist(path, key, workload, device_kind,
-                                   config_id, compiled)
-  if persisted and entry is not None:
-    # The cache entry gains a pointer to its executable — the first
-    # slice of the unified CompiledArtifact (ROADMAP item 5).
-    entry = dict(entry)
-    entry['serialized_executable'] = path
-    cache.store(key, entry)
-  return ServingExecutable(executable=compiled, key=key, workload=workload,
-                           config_id=config_id, from_cache=False,
-                           path=path if persisted else '')
+  artifact = artifact_lib.load_or_compile(
+      workload, jitted, example_args, config=winner, cache=cache,
+      persist=persist, program_key=False, telemetry=telemetry)
+  if not artifact.from_cache and entry is not None:
+    previous_config = entry.get('serialized_executable_config_id')
+    if previous_config is not None and \
+        previous_config != artifact.config_id:
+      # The startup compile was caused by WINNER DRIFT, not a cold key:
+      # a re-swept cache moved the winner, superseding the previously
+      # stamped executable. Judged by the STAMPED config id — never by
+      # path comparison, which a failed persist, a relocated cache dir,
+      # or a path-scheme migration would each misfire. A surprise
+      # multi-second warm-restart compile must be attributable from the
+      # logs alone.
+      log_warning(
+          'Serving workload %r recompiled under config %r: the tuning '
+          'cache winner moved (previously persisted under %r; '
+          'superseded executable: %s).', workload, artifact.config_id,
+          previous_config, entry.get('serialized_executable'))
+    if artifact.path:
+      # The cache entry gains a pointer to its executable (+ the config
+      # it was built under) — the tuning evidence and the program it
+      # picked stay joined.
+      entry = dict(entry)
+      entry['serialized_executable'] = artifact.path
+      entry['serialized_executable_config_id'] = artifact.config_id
+      cache.store(key, entry)
+  return ServingExecutable(executable=artifact.executable,
+                           key=artifact.key, workload=workload,
+                           config_id=artifact.config_id,
+                           from_cache=artifact.from_cache,
+                           path=artifact.path)

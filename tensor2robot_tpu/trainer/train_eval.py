@@ -131,6 +131,8 @@ class Trainer:
                owns_checkpoint_dir: bool = True,
                tuned_config: Optional[Any] = None,
                tuning_cache_path: Optional[str] = None,
+               use_compiled_artifacts: bool = False,
+               artifact_workload: Optional[str] = None,
                feed_depth: int = 1):
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
@@ -189,6 +191,23 @@ class Trainer:
     perf regression is attributable to the config that produced it.
     tuning_cache_path: cache file for the string form (default:
     tuning.default_cache_path()).
+    use_compiled_artifacts: resolve the train step through the unified
+    ``CompiledArtifact`` store (tensor2robot_tpu/compile, docs/
+    performance.md "Cold start"): at first compile the trainer looks up
+    the persisted executable for its REAL first-batch shapes — keyed by
+    workload | device_kind | jax version | shapes | lowered-program
+    hash | config — and a warm start deserializes it, so the first step
+    EXECUTES without a single XLA compile. A miss, a stale payload, or
+    a corrupt file degrades to the stock compile and persists the
+    result for next time; a tuned-config winner resolved from the cache
+    passes the same shared guard as the legacy hook (model-override
+    winners refused, ``winner_ok=False`` placeholders ignored).
+    artifact_workload: the store key's workload name. Defaults to the
+    ``tuned_config`` string when one is given (so the autotuner sweep's
+    persisted candidates are found — the winner's executable is free at
+    train time), else ``trainer_<model class name>``. The
+    lowered-program hash in the key makes name collisions harmless:
+    a different program is a miss, never a wrong load.
     feed_depth: > 1 pipelines the train channel's host->device hop
     through an N-deep :class:`~tensor2robot_tpu.data.device_feed.
     PipelinedFeed`: a producer thread transfers batches k+1..k+depth
@@ -264,8 +283,11 @@ class Trainer:
     self._device_feed_built = False
     self._tuned_config = tuned_config
     self._tuning_cache_path = tuning_cache_path
+    self._use_compiled_artifacts = bool(use_compiled_artifacts)
+    self._artifact_workload = artifact_workload
     self._feed_depth = max(1, int(feed_depth))
     self._train_step_compiled = None  # AOT executable under tuned options
+    self._train_step_artifact = None  # CompiledArtifact (provenance+HLO)
     self.active_config_id: Optional[str] = None
 
   def _put_batch(self, batch: dict, channel: str = 'train'):
@@ -366,6 +388,13 @@ class Trainer:
     from the recorded abstract args (one extra XLA compile — acceptable
     once per budgeted capture, never in the loop).
     """
+    if self._train_step_artifact is not None and \
+        self._train_step_artifact.hlo_text:
+      # Unified-artifact path: the post-optimization HLO rode the
+      # persisted payload, so forensics reads the STORED program — no
+      # relowering, and it works even for a deserialized executable
+      # whose backend cannot render text.
+      return self._train_step_artifact.hlo_text
     if self._train_step_compiled is not None:
       try:
         return self._train_step_compiled.as_text()
@@ -525,8 +554,7 @@ class Trainer:
             lambda leaf: jax.ShapeDtypeStruct(jnp.shape(leaf),
                                               jnp.result_type(leaf)),
             (state, features, labels, base_rng, force_nan))
-        self._apply_tuned_config(
-            jitted, (state, features, labels, base_rng, force_nan))
+        self._bind_compiled_step(jitted, self._step_abstract)
       if self._train_step_compiled is not None:
         return self._train_step_compiled(state, features, labels, base_rng,
                                          force_nan)
@@ -562,17 +590,75 @@ class Trainer:
         str(spec), tuning.abstract_signature(args),
         getattr(jax.devices()[0], 'device_kind', 'unknown'))
     entry = cache.lookup(key)
-    if entry is None:
-      _log('Tuning cache miss for workload %r (%s); using the stock '
-           'compile.', spec, key)
+    # The shared stale-winner guard (compile/artifact.py): cache misses,
+    # winner_ok=False placeholders, and winners carrying model_overrides
+    # (which the trainer cannot re-apply at compile time — half-applying
+    # just their flags would run an unmeasured hybrid attributed to the
+    # winner's id) all resolve to the stock compile HERE, identically
+    # for this legacy hook and the artifact load path.
+    from tensor2robot_tpu.compile import artifact as artifact_lib
+    config, reason = artifact_lib.resolve_cache_winner(entry)
+    if config is None:
+      _log('Tuning cache for workload %r (%s) yields no applicable '
+           'winner (%s); using the stock compile.%s', spec, key, reason,
+           ' Apply the overrides at model construction and pass the '
+           'config directly to use this winner.'
+           if reason == 'model_overrides' else '')
       return None, True
-    if not entry.get('winner_ok', True):
-      # Every candidate failed when this workload was swept; the stored
-      # config is a placeholder, not a measured winner.
-      _log('Tuning cache entry for %r has no valid winner; using the '
-           'stock compile.', spec)
-      return None, True
-    return tuning.CompileConfig.from_dict(entry['winner']), True
+    return config, True
+
+  def _bind_compiled_step(self, jitted, args) -> None:
+    """Binds the train-step executable at first call: the unified
+    CompiledArtifact cold-start path when ``use_compiled_artifacts``,
+    else the legacy AOT-under-tuned-options hook.
+
+    Best-effort by the same contract as the legacy hook: any store or
+    compile failure costs a log line and falls back to the stock jit
+    path, never the training run.
+    """
+    if not self._use_compiled_artifacts:
+      self._apply_tuned_config(jitted, args)
+      return
+    try:
+      from tensor2robot_tpu.compile import artifact as artifact_lib
+
+      config, from_cache = self._resolve_tuned_config(args)
+      if config is not None and config.model_overrides and not from_cache:
+        # Direct-form config: the caller applied the layout overrides at
+        # model construction (bench.py does); only the flags compile here.
+        _log('Tuned config %s carries model_overrides %s — applied at '
+             'model construction, not here.', config.config_id,
+             sorted(config.model_overrides))
+      workload = self._artifact_workload
+      if workload is None:
+        workload = (str(self._tuned_config)
+                    if isinstance(self._tuned_config, str)
+                    else 'trainer_' + type(self.model).__name__.lower())
+      with span('train.artifact_load'):
+        artifact = artifact_lib.load_or_compile(
+            workload, jitted, args, config=config,
+            cache_path=self._tuning_cache_path,
+            telemetry=self.telemetry_logger, program_key=True)
+      self._train_step_compiled = artifact.executable
+      self._train_step_artifact = artifact
+      if config is not None and (config.compiler_options
+                                 or (config.model_overrides
+                                     and not from_cache)):
+        # Same attribution rule as the legacy hook: a config took effect
+        # here (flags) or at model construction (direct-form overrides).
+        self.active_config_id = config.config_id
+      _log('Train step bound from CompiledArtifact store: workload=%s '
+           '%s (config %s, key %s).', workload,
+           'deserialized persisted executable' if artifact.from_cache
+           else 'compiled + persisted', artifact.config_id, artifact.key)
+    except Exception as e:  # noqa: BLE001 — store trouble must not kill
+      # training: degrade to the legacy hook (which itself degrades to
+      # the stock jit compile).
+      _log('CompiledArtifact bind failed (%s); using the legacy tuned '
+           'hook.', e)
+      self._train_step_compiled = None
+      self._train_step_artifact = None
+      self._apply_tuned_config(jitted, args)
 
   def _apply_tuned_config(self, jitted, args) -> None:
     """AOT-compiles the train step under the tuned compiler options.
@@ -590,18 +676,10 @@ class Trainer:
     if config is None:
       return
     if config.model_overrides:
-      if from_cache:
-        # The measured winner included layout overrides, which apply only
-        # at model construction; compiling just its flags here would run
-        # an unmeasured hybrid attributed to the winner's id. Stock
-        # compile instead — same refusal-to-misattribute as the
-        # overrides-only guard below.
-        _log('Tuned config %s from the cache carries model_overrides %s '
-             'which cannot apply at compile time; using the stock '
-             'compile. Apply the overrides at model construction and '
-             'pass the config directly to use this winner.',
-             config.config_id, sorted(config.model_overrides))
-        return
+      # Cache-resolved winners with overrides never reach here — the
+      # shared resolve_cache_winner guard already refused them — so
+      # this is the DIRECT form: the caller applied the overrides at
+      # model construction; only the flags compile below.
       _log('Tuned config %s carries model_overrides %s — layout changes '
            'apply at model construction, not here; ignoring them.',
            config.config_id, sorted(config.model_overrides))
@@ -1351,7 +1429,9 @@ def train_eval_model(t2r_model: AbstractT2RModel,
                      eval_name: Optional[str] = None,
                      profile_steps: Optional[Sequence[int]] = None,
                      auto_profile: bool = True,
-                     tuned_config: Optional[Any] = None
+                     tuned_config: Optional[Any] = None,
+                     use_compiled_artifacts: bool = False,
+                     artifact_workload: Optional[str] = None
                      ) -> Dict[str, Any]:
   """Main entry point (ref utils/train_eval.py:404).
 
@@ -1384,6 +1464,8 @@ def train_eval_model(t2r_model: AbstractT2RModel,
       profile_steps=profile_steps,
       auto_profile=auto_profile,
       tuned_config=tuned_config,
+      use_compiled_artifacts=use_compiled_artifacts,
+      artifact_workload=artifact_workload,
       # An eval-only job reads checkpoints a separate trainer process is
       # writing: it must never rename (quarantine) step dirs there.
       owns_checkpoint_dir=input_generator_train is not None)

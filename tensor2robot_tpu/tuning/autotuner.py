@@ -147,15 +147,16 @@ def compile_with_config(jitted, args,
                         config: Optional[search_space.CompileConfig]):
   """AOT-compiles ``jitted`` for ``args`` under a config's XLA options.
 
-  The ONE place compiler options meet a compile — the trainer hook and
-  the sweep both come through here. Returns the compiled executable
-  (callable with the same arguments).
+  Lowers, then delegates to ``compile/artifact.compile_lowered`` — the
+  ONE options-to-compile site every consumer (this helper, the sweep,
+  the artifact store) shares. Returns the compiled executable (callable
+  with the same arguments).
   """
-  lowered = jitted.lower(*args)
-  options = dict(config.compiler_options) if config else {}
-  if options:
-    return lowered.compile(compiler_options=options)
-  return lowered.compile()
+  from tensor2robot_tpu.compile import artifact as artifact_lib
+
+  return artifact_lib.compile_lowered(
+      jitted.lower(*args),
+      dict(config.compiler_options) if config else {})
 
 
 def _default_sync(out):
@@ -175,7 +176,8 @@ def sweep(workload: str,
           warmup_steps: int = 2,
           timer: Callable[[], float] = time.perf_counter,
           sync: Optional[Callable[[Any], Any]] = None,
-          force: bool = False) -> SweepResult:
+          force: bool = False,
+          persist_artifacts: bool = True) -> SweepResult:
   """Runs (or short-circuits via cache) one compile-config sweep.
 
   Args:
@@ -183,7 +185,7 @@ def sweep(workload: str,
     build: ``config -> StepCase``. Called once per candidate — model
       layout overrides happen here (the caller rebuilds its model from
       ``config.model_overrides``); compiler options are applied by the
-      sweep itself via :func:`compile_with_config`.
+      sweep itself at its lower+compile step.
     candidates: search space; defaults to
       ``search_space.candidate_configs()`` for the live backend.
     example_args: pytree whose shapes/dtypes key the cache. Defaults to
@@ -196,6 +198,12 @@ def sweep(workload: str,
     timer/sync: injectable for tests (a stubbed timer makes winner
       selection a pure function of its scripted values).
     force: re-sweep even on a cache hit.
+    persist_artifacts: serialize every successfully-measured candidate's
+      executable into the unified ``CompiledArtifact`` store next to
+      the cache (tensor2robot_tpu/compile) — the sweep already paid for
+      each AOT compile, so persisting them makes the winner's
+      executable FREE at train time (the trainer's artifact cold-start
+      path loads it by the same workload/shapes/config key).
 
   Returns a :class:`SweepResult`; ``.winner`` is None only when every
   candidate failed to compile.
@@ -245,7 +253,14 @@ def sweep(workload: str,
       else:
         case = build(config)
       t0 = time.perf_counter()
-      compiled = compile_with_config(case.jitted, case.args, config)
+      # Lowered kept explicitly (not via compile_with_config): its text
+      # hash is the program-identity component of the candidate's
+      # artifact key — model_overrides candidates compile a DIFFERENT
+      # program and must persist under a different key.
+      from tensor2robot_tpu.compile import artifact as artifact_lib
+      lowered = case.jitted.lower(*case.args)
+      options = dict(config.compiler_options) if config else {}
+      compiled = artifact_lib.compile_lowered(lowered, options)
       result.compile_s = time.perf_counter() - t0
     except Exception as e:  # noqa: BLE001 — unknown flag, OOM, ...
       result.error = '{}: {}'.format(type(e).__name__, str(e)[:300])
@@ -278,6 +293,24 @@ def sweep(workload: str,
       _log('Candidate %s: %.2f steps/s (median %.4fs, spread %.4fs)',
            config.config_id, result.steps_per_s, result.median_s,
            result.spread_s)
+      if persist_artifacts:
+        # The sweep already paid for this AOT compile; persisting it
+        # makes the eventual winner's executable a zero-compile load at
+        # train time. Best-effort: a backend without serialization
+        # still sweeps normally.
+        try:
+          from tensor2robot_tpu.compile import artifact as artifact_lib
+
+          store = artifact_lib.ArtifactStore(cache.path)
+          lowered_sha = artifact_lib.program_sha(lowered.as_text())
+          artifact_key = artifact_lib.artifact_key(
+              workload, signature, device_kind, lowered_sha=lowered_sha)
+          store.persist(workload, artifact_key, config.config_id,
+                        options, compiled, lowered_sha=lowered_sha,
+                        fingerprint=result.hlo_fingerprint or None)
+        except Exception as e:  # noqa: BLE001
+          _log('Could not persist candidate %s artifact: %s',
+               config.config_id, e)
     except Exception as e:  # noqa: BLE001 — runtime failure mid-timing
       result.error = '{}: {}'.format(type(e).__name__, str(e)[:300])
       result.compile_ok = False

@@ -40,8 +40,12 @@ import os
 # actor<->learner loop (rl/loop.py) are all durations — a wall-clock
 # jump must not fabricate an acting-step regression or end a run early;
 # the vectorized envs are pure functions and must stay clock-free.
+# 'compile' joined with ISSUE 13: the CompiledArtifact load/compile
+# timings and the coldstart time-to-first-step measurement are
+# durations a wall-clock jump must not corrupt — a fabricated
+# negative compile_ms would poison the cold-start trajectory table.
 SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
-                    'serving', 'replay', 'envs', 'rl')
+                    'serving', 'replay', 'envs', 'rl', 'compile')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
