@@ -1737,6 +1737,45 @@ def _bench_serving(model, mesh, on_tpu: bool,
   }
 
 
+def _bench_serving_fleet(on_tpu: bool, duration_s: float = None):
+  """Aggregate throughput-at-SLO vs replica count (ISSUE 14, ROADMAP 3).
+
+  Runs ``serving/fleet_bench.py`` in a SUBPROCESS and returns its
+  schema-locked ``SERVING_FLEET_BENCH_KEYS`` payload: a ``ServingFleet``
+  of 1 / 2 / 4 PolicyServer replicas behind the telemetry-weighted
+  router, driven by closed-loop clients — aggregate actions/sec + fleet
+  p99 per replica count (``serving_fleet_scaling_monotonic`` is the
+  1 -> 2 -> 4 strictly-increasing check), zero request-time compiles,
+  an artifact-warm 1 -> 4 scale-out with ``jax/compiles`` delta 0 and
+  its ``fleet_scaleup_time_to_ready_s``, and a mid-load rolling swap
+  with zero failed requests + both versions served.
+
+  Subprocess because the CPU leg pins XLA intra-op parallelism down
+  (``--xla_cpu_multi_thread_eigen=false``, read at backend init): one
+  executable spread across every core makes N concurrent replicas fight
+  for the same cores, and the curve would measure scheduler thrash
+  instead of routing (full rationale in fleet_bench.py's docstring).
+  """
+  import subprocess
+  import sys as _sys
+
+  if duration_s is None:
+    duration_s = 6.0 if on_tpu else 3.0
+  env = dict(os.environ)
+  if not on_tpu:
+    env['XLA_FLAGS'] = (env.get('XLA_FLAGS', '') +
+                        ' --xla_cpu_multi_thread_eigen=false').strip()
+  result = subprocess.run(
+      [_sys.executable, '-m', 'tensor2robot_tpu.serving.fleet_bench',
+       '--duration', str(duration_s)],
+      capture_output=True, text=True, timeout=900, env=env,
+      cwd=os.path.dirname(os.path.abspath(__file__)))
+  if result.returncode != 0:
+    raise RuntimeError('fleet_bench subprocess failed: {}\n{}'.format(
+        result.stdout[-500:], result.stderr[-2000:]))
+  return json.loads(result.stdout.strip().splitlines()[-1])
+
+
 def _bench_maml_model(maml, mesh, n_steps: int):
   """Shared MAML timing: chain n_steps meta steps inside ONE jit (the
   seq2act method — per-dispatch tunnel latency excluded by construction,
@@ -2181,6 +2220,22 @@ def main():
     out['serving'] = {'error': repr(e)[:200]}
     out['serving_actions_per_sec'] = -1.0
     out['serving_p99_ms'] = -1.0
+
+  try:
+    # Serving-fleet axis (ISSUE 14): aggregate throughput-at-SLO vs
+    # replica count behind the telemetry-weighted router, artifact-warm
+    # scale-out (zero compiles on replicas 2..N), and a mid-load
+    # rolling swap with zero failed requests fleet-wide.
+    out.update(_bench_serving_fleet(on_tpu))
+    from tensor2robot_tpu.serving.fleet import SERVING_FLEET_BENCH_KEYS
+    fleet_missing = [key for key in SERVING_FLEET_BENCH_KEYS
+                     if key not in out]
+    if fleet_missing:
+      out['serving_fleet_schema_missing'] = fleet_missing
+  except Exception as e:  # noqa: BLE001
+    out['serving_fleet_actions_per_sec_r1'] = -1.0
+    out['serving_fleet_scaling_monotonic'] = False
+    out['serving_fleet_error'] = repr(e)[:200]
 
   try:
     # Closed-loop RL axis (ISSUE 12): the live actor<->learner cycle —

@@ -125,12 +125,13 @@ def diagnose(model_dir: str,
     if candidate and (beat is None or
                       candidate.get('time', 0) > beat.get('time', 0)):
       beat = candidate
-  # 'serving_stop'/'replay_stop'/'rl_stop' count as orderly ends: a
-  # PolicyServer, ReplayService or RL loop that closed cleanly stops
-  # heartbeating by design, which is not a wedged process.
+  # 'serving_stop'/'replay_stop'/'rl_stop'/'serving_fleet_stop' count
+  # as orderly ends: a PolicyServer, ReplayService, RL loop or serving
+  # fleet that closed cleanly stops heartbeating by design, which is
+  # not a wedged process.
   run_ended = bool(records) and records[-1].get('kind') in (
       'run_end', 'run_abort', 'preempted', 'serving_stop', 'replay_stop',
-      'rl_stop')
+      'rl_stop', 'serving_fleet_stop')
   if run_ended and beat is not None:
     findings.append(_finding(
         INFO, 'run finished ({}); heartbeat age not meaningful'.format(
@@ -298,6 +299,95 @@ def diagnose(model_dir: str,
               latest.get('p99_ms', 0.0), latest.get('slo_ms', 0.0),
               latest.get('batch_fill', 0.0),
               latest.get('params_version', 0))))
+
+  # Serving-fleet section (ISSUE 14): kind='serving_fleet'
+  # (t2r.serving_fleet.v1) windows from a ServingFleet router — the
+  # primary stream of a fleet-shaped serving dir (the router owns
+  # stream 0; replicas 1..N federate underneath). Two page-worthy
+  # conditions, each NAMING the replica: a replica breaching its SLO in
+  # the newest evidence while the fleet is live, and a replica ejected
+  # from rotation (heartbeat stale / dead) that has not returned.
+  fleet_serving = [r for r in records
+                   if r.get('kind') == 'serving_fleet']
+  if fleet_serving:
+    latest = fleet_serving[-1]
+    # Per-replica SLO breaches across the fleet history.
+    breaches_by_replica: Dict[str, List[int]] = {}
+    for index, record in enumerate(records):
+      if record.get('kind') != 'serving_fleet':
+        continue
+      for replica, entry in sorted((record.get('replicas') or {}).items()):
+        if entry.get('over_slo') and (entry.get('requests') or 0) > 0:
+          breaches_by_replica.setdefault(replica, []).append(index)
+    for replica, indices in sorted(breaches_by_replica.items()):
+      last_index = indices[-1]
+      entry = (records[last_index].get('replicas') or {}).get(replica, {})
+      # Recovery check (the serving-section rule, per replica): a LATER
+      # fleet window where THIS replica handled traffic back under its
+      # SLO means the breach passed — history, not a live page.
+      recovered = any(
+          r.get('kind') == 'serving_fleet'
+          and not ((r.get('replicas') or {}).get(replica) or {})
+              .get('over_slo')
+          and (((r.get('replicas') or {}).get(replica) or {})
+               .get('requests') or 0) > 0
+          for r in records[last_index + 1:])
+      findings.append(_finding(
+          WARNING if (run_ended or recovered) else CRITICAL,
+          'serving fleet: replica {} p99 {:.1f} ms exceeded its {:g} ms '
+          'SLO in {} window(s){} — one replica out of envelope drags '
+          'every request routed to it'.format(
+              replica, entry.get('p99_ms') or 0.0,
+              entry.get('slo_ms') or 0.0, len(indices),
+              ' — recovered since' if recovered
+              else (' (run ended)' if run_ended else ' (live)')),
+          kind='fleet_replica_over_slo', replica=replica,
+          p99_ms=entry.get('p99_ms'), slo_ms=entry.get('slo_ms'),
+          count=len(indices), recovered=recovered))
+    ejected_now = [str(replica) for replica in latest.get('ejected') or []]
+    if ejected_now:
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'serving fleet: replica{} {} ejected from rotation (heartbeat '
+          'stale or dead) and {} not returned — the fleet serves on {} '
+          'of {} replicas'.format(
+              's' if len(ejected_now) > 1 else '',
+              ', '.join(ejected_now),
+              'have' if len(ejected_now) > 1 else 'has',
+              latest.get('healthy_count'), latest.get('replica_count')),
+          kind='fleet_replica_ejected', replicas=ejected_now,
+          healthy_count=latest.get('healthy_count'),
+          replica_count=latest.get('replica_count')))
+    elif (latest.get('ejections_total') or 0) > 0:
+      findings.append(_finding(
+          WARNING, 'serving fleet: {:g} ejection(s) occurred (every '
+          'ejected replica has since returned to rotation); retried '
+          'requests so far: {:g}'.format(
+              latest.get('ejections_total') or 0,
+              latest.get('retries_total') or 0),
+          kind='fleet_ejections_recovered',
+          ejections_total=latest.get('ejections_total')))
+    rejected = latest.get('rejected_total') or 0
+    if rejected > 0:
+      findings.append(_finding(
+          WARNING, 'serving fleet: router shed {:g} request(s) at the '
+          'door (fleet-wide pending cap): demand exceeds the replica '
+          'set — scale up'.format(rejected), kind='fleet_shed',
+          rejected_total=rejected))
+    if not breaches_by_replica and not ejected_now:
+      findings.append(_finding(
+          INFO, 'serving fleet healthy: {} replica(s) ({} healthy), '
+          '{:.1f} actions/s aggregate, fleet p99 {:.1f} ms vs SLO '
+          '{:g} ms, versions serving {}'.format(
+              latest.get('replica_count'), latest.get('healthy_count'),
+              latest.get('actions_per_sec', 0.0),
+              latest.get('p99_ms', 0.0), latest.get('slo_ms', 0.0),
+              latest.get('versions_serving')),
+          kind='fleet_healthy',
+          replica_count=latest.get('replica_count'),
+          healthy_count=latest.get('healthy_count'),
+          actions_per_sec=latest.get('actions_per_sec'),
+          p99_ms=latest.get('p99_ms'), slo_ms=latest.get('slo_ms')))
 
   # Replay section (ISSUE 11): kind='replay' (t2r.replay.v1) windows
   # from a ReplayService. The one condition a replay fleet pages on: a

@@ -29,6 +29,23 @@ from tensor2robot_tpu.serving.batcher import (
     pad_batch,
     split_outputs,
 )
+from tensor2robot_tpu.serving.fleet import (
+    SERVING_FLEET_BENCH_KEYS,
+    SERVING_FLEET_RECORD_KIND,
+    SERVING_FLEET_SCHEMA,
+    ServingFleet,
+    ServingFleetConfig,
+    replica_host_meta,
+    router_host_meta,
+)
+from tensor2robot_tpu.serving.router import (
+    FleetRouter,
+    HttpReplicaHandle,
+    LocalReplicaHandle,
+    ReplicaHandle,
+    RoutedResult,
+    RouterConfig,
+)
 from tensor2robot_tpu.serving.server import (
     PolicyServer,
     ServeResult,
@@ -39,16 +56,29 @@ from tensor2robot_tpu.serving.server import (
 __all__ = [
     'AdmissionController',
     'DeadlineBatcher',
+    'FleetRouter',
+    'HttpReplicaHandle',
+    'LocalReplicaHandle',
     'PendingRequest',
     'PolicyServer',
+    'ReplicaHandle',
     'RequestRejected',
+    'RoutedResult',
+    'RouterConfig',
+    'SERVING_FLEET_BENCH_KEYS',
+    'SERVING_FLEET_RECORD_KIND',
+    'SERVING_FLEET_SCHEMA',
     'SERVING_RECORD_KIND',
     'SERVING_REJECTED_COUNTER',
     'ServeResult',
     'ServingConfig',
     'ServingExecutable',
+    'ServingFleet',
+    'ServingFleetConfig',
     'artifact_path_for_key',
     'load_or_compile',
     'pad_batch',
     'split_outputs',
+    'replica_host_meta',
+    'router_host_meta',
 ]

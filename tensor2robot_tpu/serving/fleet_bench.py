@@ -1,0 +1,316 @@
+"""Runnable serving-fleet bench: aggregate throughput-at-SLO vs replicas.
+
+``python -m tensor2robot_tpu.serving.fleet_bench`` stands up a
+``ServingFleet`` of 1 / 2 / 4 PolicyServer replicas behind the
+telemetry-weighted router and prints ONE JSON line carrying the
+``SERVING_FLEET_BENCH_KEYS`` quantities (serving/fleet.py; schema-locked
+by bin/check_serving_slo). ``bench.py`` runs it in a SUBPROCESS because
+the CPU leg needs a process-level XLA knob:
+
+**Why a subprocess + ``--xla_cpu_multi_thread_eigen=false`` on CPU.**
+XLA:CPU parallelizes ONE executable across the whole core pool; N
+concurrent replica executions then fight each other (and the client
+threads) for the same cores, so the 4-replica batch time inflates ~2x
+and the scaling curve measures scheduler thrash, not routing. Serving
+deployments pin intra-op parallelism down for exactly this reason —
+throughput-oriented batching wants N independent single-core(ish)
+executions, not one N-core execution at a time. The flag is read at
+backend init, hence the fresh process. On TPU the executable owns its
+chip and no flag is needed.
+
+The policy program is the sim critic's one-dispatch CEM selector
+(``rl.loop.make_cem_select_fn`` — the flagship's spec keys, sized for
+the CPU envelope): this axis measures the FLEET (routing, scale-out,
+rolling swap), and needs a program whose single-replica p99 sits inside
+the 33 ms SLO on CPU so the curve is a routing fact. The flagship's
+full-resolution single-server numbers are the adjacent ``serving_*``
+bench axis.
+
+Contracts measured, not asserted:
+  * ``serving_fleet_request_time_compiles`` — ``jax/compiles`` delta
+    across every load phase (must be 0: replicas execute one AOT
+    program).
+  * ``serving_fleet_scaleup_compiles`` — delta across the 4-replica
+    run's artifact-warm scale-out from 1 -> 4 replicas (must be 0: each
+    new replica deserializes the persisted ``CompiledArtifact``).
+  * ``fleet_scaleup_time_to_ready_s`` — slowest artifact-warm scale-up,
+    factory start through rotation entry.
+  * ``serving_fleet_swap_failed`` / ``..._swap_versions_served`` — the
+    mid-load rolling swap: zero failed requests fleet-wide, both
+    versions observed serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def build_sim_batch_select(height: int, width: int, cem_samples: int,
+                           cem_iters: int, num_elites: int):
+  """(jitted batch_select, variables, feature_spec) for the sim critic.
+
+  Shared by the bench runnable and tests/test_serving_fleet.py's slow
+  end-to-end check — one definition of the fleet's policy program.
+  """
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from tensor2robot_tpu.data.input_generators import (
+      DefaultRandomInputGenerator,
+  )
+  from tensor2robot_tpu.modes import ModeKeys
+  from tensor2robot_tpu.research.qtopt import grasping_sim
+  from tensor2robot_tpu.rl.loop import make_cem_select_fn
+
+  model = grasping_sim.make_sim_critic_model(height=height, width=width)
+  select = make_cem_select_fn(model, cem_samples=cem_samples,
+                              cem_iters=cem_iters, num_elites=num_elites)
+  batched = jax.vmap(select, in_axes=(None, 0, 0))
+
+  def batch_select(variables, states, seed):
+    rows = jax.tree_util.tree_leaves(states)[0].shape[0]
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.PRNGKey(seed), i))(
+            jnp.arange(rows, dtype=jnp.uint32))
+    actions, q = batched(variables, dict(states), keys)
+    return {'action': actions, 'q': q}
+
+  generator = DefaultRandomInputGenerator(batch_size=2)
+  generator.set_specification_from_model(model, ModeKeys.TRAIN)
+  features, labels = next(
+      generator.create_dataset_iterator(mode=ModeKeys.TRAIN, seed=0))
+  feats_p, labels_p = model.preprocessor.preprocess(
+      features, labels, ModeKeys.EVAL)
+  variables = model.init_variables(jax.random.PRNGKey(0), feats_p,
+                                   labels_p, ModeKeys.EVAL)
+  feature_spec = {
+      'image': ((height, width, 3), np.uint8),
+      'gripper_closed': ((), np.float32),
+      'height_to_bottom': ((), np.float32),
+  }
+  return jax.jit(batch_select), variables, feature_spec
+
+
+def run_bench(batch: int = 8, height: int = 96, width: int = 128,
+              cem_samples: int = 32, cem_iters: int = 2,
+              num_elites: int = 8, duration_s: float = 3.0,
+              replica_counts=(1, 2, 4)) -> dict:
+  import jax
+  import numpy as np
+
+  from tensor2robot_tpu.observability import (
+      TelemetryRegistry,
+      get_registry,
+      install_jax_listeners,
+  )
+  from tensor2robot_tpu.observability.signals import COMPILE_COUNTER
+  from tensor2robot_tpu.serving import (
+      LocalReplicaHandle,
+      PolicyServer,
+      ServingConfig,
+      ServingFleet,
+      ServingFleetConfig,
+      load_or_compile,
+  )
+  from tensor2robot_tpu.tuning import cache as cache_lib
+
+  jitted, variables, feature_spec = build_sim_batch_select(
+      height, width, cem_samples, cem_iters, num_elites)
+  abstract_args = (
+      jax.tree_util.tree_map(
+          lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), variables),
+      {name: jax.ShapeDtypeStruct((batch,) + shape, np.dtype(dtype))
+       for name, (shape, dtype) in feature_spec.items()},
+      jax.ShapeDtypeStruct((), 'uint32'))
+
+  install_jax_listeners()
+  compile_counter = get_registry().counter(COMPILE_COUNTER)
+  cache = cache_lib.ConfigCache(
+      os.path.join(tempfile.mkdtemp(prefix='fleet_bench_'),
+                   'tuning_cache.json'))
+  workload = 'serving_fleet_sim_cem_b{}'.format(batch)
+  # The ONE startup compile; every replica after this is a store hit.
+  load_or_compile(workload, jitted, abstract_args, cache=cache)
+
+  serving_config = ServingConfig(max_batch_size=batch, max_wait_ms=2.0,
+                                 max_queue_depth=8 * batch, slo_ms=33.0,
+                                 report_interval_s=0.5)
+  warm_state = {
+      name: np.zeros((batch,) + shape, dtype)
+      for name, (shape, dtype) in feature_spec.items()}
+
+  def run_fleet(replicas, with_swap=False, scale_from_one=False):
+    registry = TelemetryRegistry()  # per-fleet: p99 must not mix runs
+
+    def factory(replica_id, telemetry):
+      artifact = load_or_compile(workload, jitted, abstract_args,
+                                 cache=cache)
+      # One warm batch BEFORE the replica enters rotation: the first
+      # dispatch of a deserialized executable pays one-time runtime
+      # setup, which is readiness cost (it stays inside
+      # time_to_ready_s), not request latency.
+      jax.block_until_ready(
+          artifact.executable(variables, warm_state, np.uint32(0)))
+      server = PolicyServer(
+          artifact.executable, variables, serving_config, version=1,
+          telemetry=telemetry, feature_spec=feature_spec,
+          registry=registry,
+          aot_info={'aot_startup': True,
+                    'from_cache': artifact.from_cache})
+      server.start()
+      return LocalReplicaHandle(replica_id, server)
+
+    config = ServingFleetConfig(
+        min_replicas=1, max_replicas=replicas, autoscale=False,
+        report_interval_s=0.5, health_interval_s=0.2,
+        stale_after_s=10.0, slo_ms=33.0)
+    fleet_dir = tempfile.mkdtemp()
+    fleet = ServingFleet(
+        factory, config, model_dir=fleet_dir,
+        initial_replicas=1 if scale_from_one else replicas,
+        registry=registry)
+    fleet.start()
+    scaleup_seconds = []
+    compiles_before_scaleup = compile_counter.value
+    if scale_from_one:
+      for _ in range(replicas - 1):
+        _, ready_s = fleet.scale_up(reason='bench')
+        scaleup_seconds.append(ready_s)
+    scaleup_compiles = compile_counter.value - compiles_before_scaleup
+
+    stop = threading.Event()
+    completed = [0]
+    versions = set()
+    failures = []
+    lock = threading.Lock()
+
+    def client(seed):
+      client_rng = np.random.RandomState(seed)
+      state = {'image': client_rng.randint(0, 255, (height, width, 3)
+                                           ).astype(np.uint8),
+               'gripper_closed': np.float32(0.0),
+               'height_to_bottom': np.float32(0.1)}
+      while not stop.is_set():
+        try:
+          result = fleet.select_action(state, timeout_s=120.0)
+          with lock:
+            completed[0] += 1
+            versions.add(result.version)
+        except Exception as e:  # noqa: BLE001 — every failure is the metric
+          with lock:
+            failures.append(repr(e)[:120])
+
+    # 1.25x each replica's batch in closed-loop clients: enough
+    # pressure to keep every batcher fed (the curve measures capacity,
+    # not demand) without queueing so deep that the client threads'
+    # own GIL contention becomes the thing measured.
+    clients = max(batch, (5 * batch * replicas) // 4)
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    compiles_before = compile_counter.value
+    start = time.perf_counter()
+    for t in threads:
+      t.start()
+    if with_swap:
+      time.sleep(duration_s / 2)
+      # Same weights re-labeled v2 (what a trainer checkpoint poll
+      # does), walked across the fleet one replica at a time.
+      fleet.rolling_swap(variables, version=2)
+      time.sleep(duration_s / 2)
+    else:
+      time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+      t.join()
+    elapsed = time.perf_counter() - start
+    request_compiles = compile_counter.value - compiles_before
+    stats = fleet.stats()
+    fleet.close()
+    # The published p99 is the MEDIAN steady-state WINDOW p99 from the
+    # fleet's own t2r.serving_fleet.v1 records — the same windowed
+    # quantity the live SLO monitoring (and doctor) judge. A cumulative
+    # whole-run p99 on a seconds-long CPU run is one scheduler stall
+    # away from a 3x outlier, which would measure the container's
+    # ambient load, not the fleet.
+    from tensor2robot_tpu.observability import read_telemetry
+    window_p99s = [
+        r['p99_ms'] for r in read_telemetry(
+            os.path.join(fleet_dir, 'telemetry.0.jsonl'))
+        if r.get('kind') == 'serving_fleet'
+        and (r.get('requests') or 0) >= 100]
+    if window_p99s:
+      p99 = sorted(window_p99s)[len(window_p99s) // 2]
+    else:
+      p99 = stats['latency_ms'].get('p99', 0.0)
+    return {
+        'replicas': replicas,
+        'actions_per_sec': round(completed[0] / elapsed, 2),
+        'p99_ms': round(p99, 2),
+        'p99_ms_cumulative': round(
+            stats['latency_ms'].get('p99', 0.0), 2),
+        'window_p99s_ms': [round(p, 2) for p in window_p99s],
+        'slo_met': bool(completed[0] > 0 and p99 < 33.0),
+        'failed': len(failures),
+        'versions_served': sorted(versions),
+        'request_time_compiles': request_compiles,
+        'scaleup_compiles': scaleup_compiles,
+        'scaleup_seconds': [round(s, 4) for s in scaleup_seconds],
+        'clients': clients,
+    }
+
+  counts = sorted(replica_counts)
+  runs = {}
+  for n in counts:
+    biggest = n == counts[-1] and n > 1
+    runs[n] = run_fleet(n, with_swap=biggest, scale_from_one=biggest)
+  curve = [runs[n]['actions_per_sec'] for n in counts]
+  top = runs[counts[-1]]
+  out = {
+      'serving_fleet_scaling_monotonic': bool(
+          all(a < b for a, b in zip(curve, curve[1:]))),
+      'serving_fleet_request_time_compiles': sum(
+          r['request_time_compiles'] for r in runs.values()),
+      'serving_fleet_scaleup_compiles': top['scaleup_compiles'],
+      'fleet_scaleup_time_to_ready_s': round(
+          max(top['scaleup_seconds'] or [0.0]), 4),
+      'serving_fleet_swap_failed': top['failed'],
+      'serving_fleet_swap_versions_served': top['versions_served'],
+      'serving_fleet': {str(n): runs[n] for n in counts},
+  }
+  for n in counts:
+    out['serving_fleet_actions_per_sec_r{}'.format(n)] = \
+        runs[n]['actions_per_sec']
+    out['serving_fleet_p99_ms_r{}'.format(n)] = runs[n]['p99_ms']
+  return out
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--batch', type=int, default=8)
+  parser.add_argument('--height', type=int, default=96)
+  parser.add_argument('--width', type=int, default=128)
+  parser.add_argument('--cem_samples', type=int, default=32)
+  parser.add_argument('--cem_iters', type=int, default=2)
+  parser.add_argument('--num_elites', type=int, default=8)
+  parser.add_argument('--duration', type=float, default=3.0)
+  parser.add_argument('--replica_counts', default='1,2,4')
+  args = parser.parse_args(argv)
+  out = run_bench(
+      batch=args.batch, height=args.height, width=args.width,
+      cem_samples=args.cem_samples, cem_iters=args.cem_iters,
+      num_elites=args.num_elites, duration_s=args.duration,
+      replica_counts=tuple(int(n) for n in
+                           args.replica_counts.split(',')))
+  print(json.dumps(out))
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,4 +1,4 @@
-"""Stdlib HTTP/JSON front door for a PolicyServer (``t2r_serve``).
+"""Stdlib HTTP/JSON front door for a PolicyServer or a ServingFleet.
 
 One thread per connection (``ThreadingHTTPServer``) feeding the shared
 batcher — which is exactly the point: N concurrent HTTP callers coalesce
@@ -6,15 +6,24 @@ into megabatches behind one compiled program. JSON arrays are the wire
 format (no external deps); the server's ``feature_spec`` casts them to
 the executable's dtypes, so clients send plain nested lists.
 
+The handler needs only ``submit(features) -> Future`` and ``stats()``,
+so ``t2r_serve --replicas N`` mounts a :class:`~...fleet.ServingFleet`
+(whose router front-ends the replica set) on the exact same door — a
+ROUTER-level fleet-wide shed (:class:`RequestRejected` before any
+replica queue is touched) answers the same 503 a single server's
+admission control does, never a dropped connection (ISSUE 14
+satellite, the PR 7/PR 10 frontend bug class).
+
 Endpoints:
   * ``POST /v1/select_action`` — body ``{"features": {name: value}}``;
     200 -> ``{"outputs": {...}, "version": int, "latency_ms": float}``;
     400 on malformed/spec-violating requests, 503 when admission control
-    sheds the request (retry against another replica), 500 on a failed
-    batch.
-  * ``GET /healthz`` — cumulative :meth:`PolicyServer.stats` as JSON.
-  * ``GET /metricz`` — the registry's ``serving/`` + ``inference/``
-    scalars (flat tag -> value JSON).
+    (or the fleet router) sheds the request (retry against another
+    replica/fleet), 500 on a failed batch.
+  * ``GET /healthz`` — cumulative ``stats()`` as JSON (the fleet's
+    version includes per-replica + ejection/scale totals).
+  * ``GET /metricz`` — the registry's ``serving/`` + ``serving_fleet/``
+    + ``inference/`` scalars (flat tag -> value JSON).
 """
 
 from __future__ import annotations
@@ -41,8 +50,10 @@ def _jsonable(value):
 
 
 class _Handler(BaseHTTPRequestHandler):
-  # Set by build_http_server on the subclass.
-  policy_server: PolicyServer = None
+  # Set by build_http_server on the subclass. Duck-typed: a
+  # PolicyServer or anything else exposing submit()/stats() (the
+  # ServingFleet / FleetRouter front the same door).
+  policy_server = None  # type: PolicyServer
   request_timeout_s: float = 60.0
 
   def log_message(self, *args) -> None:  # quiet: telemetry is the log
@@ -63,7 +74,8 @@ class _Handler(BaseHTTPRequestHandler):
     elif self.path == '/metricz':
       scalars = get_registry().scalars()
       self._reply(200, {tag: value for tag, value in sorted(scalars.items())
-                        if tag.startswith(('serving/', 'inference/'))})
+                        if tag.startswith(('serving/', 'serving_fleet/',
+                                           'inference/'))})
     else:
       self._reply(404, {'error': 'unknown path {}'.format(self.path)})
 
@@ -107,16 +119,18 @@ class _Handler(BaseHTTPRequestHandler):
     })
 
 
-def build_http_server(policy_server: PolicyServer,
+def build_http_server(policy_server,
                       host: str = '127.0.0.1',
                       port: int = 0,
                       request_timeout_s: float = 60.0
                       ) -> Tuple[ThreadingHTTPServer, int]:
   """Binds the HTTP front end; returns ``(httpd, bound_port)``.
 
-  ``port=0`` binds an ephemeral port (tests). Call
-  ``httpd.serve_forever()`` (blocking) or drive it from a thread;
-  ``httpd.shutdown()`` stops it — then close the PolicyServer.
+  ``policy_server`` is a :class:`PolicyServer` or a
+  :class:`~tensor2robot_tpu.serving.fleet.ServingFleet` (anything with
+  ``submit``/``stats``). ``port=0`` binds an ephemeral port (tests).
+  Call ``httpd.serve_forever()`` (blocking) or drive it from a thread;
+  ``httpd.shutdown()`` stops it — then close the server/fleet.
   """
   handler = type('PolicyHandler', (_Handler,), {
       'policy_server': policy_server,

@@ -218,6 +218,13 @@ class PolicyServer:
     self._window_rows = 0
     self._window_padded = 0
 
+    # Fleet-observatory surface (ISSUE 14): the router reads the last
+    # closed SLO window (weights) and the report age (liveness) — a
+    # serve loop wedged inside a batch stops reporting, which is the
+    # same "heartbeat went stale" signal the fleet watchdog keys on.
+    self.last_report: Optional[Dict[str, object]] = None
+    self._last_report_at = self._clock()
+
     # Drain accounting: a request is "accepted" at submit and "answered"
     # when its future resolves — so drain() can never observe the gap
     # between a batch leaving the queue and entering execution.
@@ -270,6 +277,20 @@ class PolicyServer:
       if self._owns_telemetry:
         self._telemetry.close()
     self._queue_gauge.set(0.0)
+
+  @property
+  def alive(self) -> bool:
+    """Whether the serve loop thread is running (started, not closed)."""
+    return self._worker is not None and self._worker.is_alive()
+
+  def report_age_s(self) -> float:
+    """Seconds since the serve loop last closed an SLO report window.
+
+    The in-process heartbeat the fleet router ejects on: a healthy loop
+    reports every ``report_interval_s``; a loop wedged inside a hung
+    batch (or dead) stops, and this age grows without bound.
+    """
+    return self._clock() - self._last_report_at
 
   def drain(self, timeout_s: float = 30.0) -> bool:
     """Blocks until every accepted request has been ANSWERED (True), or
@@ -483,6 +504,8 @@ class PolicyServer:
         'rejected_total': self._admission.rejected_total,
         'params_version': self._params.version,
     }
+    self.last_report = record
+    self._last_report_at = now
     if self._telemetry is not None:
       self._telemetry.log(SERVING_RECORD_KIND, **record)
       self._telemetry.heartbeat()
@@ -500,6 +523,7 @@ class PolicyServer:
         'padding_waste_total': self._padding_counter.value,
         'swaps_total': self._swaps_counter.value,
         'queue_depth': self._batcher.pending_count(),
+        'max_queue_depth': self.config.max_queue_depth,
         'params_version': self._params.version,
         'latency_ms': self._request_latency.summary(),
         'batch_size': self._batch_size_hist.summary(),

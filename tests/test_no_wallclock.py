@@ -44,6 +44,13 @@ import os
 # timings and the coldstart time-to-first-step measurement are
 # durations a wall-clock jump must not corrupt — a fabricated
 # negative compile_ms would poison the cold-start trajectory table.
+# ISSUE 14's fleet modules (serving/router.py, serving/fleet.py,
+# serving/fleet_bench.py) ride the existing 'serving' entry: replica
+# heartbeat ages, ejection staleness, scale-up time-to-ready and the
+# fleet report windows are ALL durations (monotonic by construction —
+# an NTP step must not eject a healthy replica or fake a scale-up
+# latency); fleet telemetry timestamps go through TelemetryLogger
+# (already annotated).
 SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
                     'serving', 'replay', 'envs', 'rl', 'compile')
 MARKER = 'wall-clock'
