@@ -1566,6 +1566,36 @@ def _bench_coldstart(on_tpu: bool):
     shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_elastic():
+  """Elastic axis (ISSUE 15): the shrink-then-grow acceptance ladder as
+  a bench measurement.
+
+  ``run_elastic_fleet`` spawns 3 real ``elastic.driver`` subprocesses
+  (each its own jax runtime on virtual CPU devices — the same harness
+  behind tests/test_elastic.py and the MULTICHIP elastic phase),
+  SIGKILLs host 1 mid-run, waits for the coordinator's lease-lapse
+  shrink + ``t2r.recovery.v1`` record, relaunches the victim, and waits
+  for the grow back to world 3. Publishes ELASTIC_BENCH_KEYS
+  (elastic/axes.py, schema-locked by bin/check_elastic_doctor): the
+  host-count scaling curve, the recovery phase split summing to
+  ``elastic_recovery_seconds``, and ``elastic_surviving_compiles`` —
+  the zero-compile warm-rebind contract as a number (must be 0).
+  """
+  import shutil
+
+  from tensor2robot_tpu.elastic import axes as elastic_axes_lib
+
+  tmp = tempfile.mkdtemp(prefix='t2r_bench_elastic_')
+  try:
+    result = elastic_axes_lib.run_elastic_fleet(
+        tmp, hosts=3, kill_host=1, local_device_count=2,
+        boundary_steps=2, lease_ttl_secs=4.0, renew_secs=0.5,
+        kill_after_step=2)
+    return dict(result['axes'])
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _bench_serving(model, mesh, on_tpu: bool,
                    batch: int = 8,
                    cem_samples: int = 64,
@@ -2268,6 +2298,23 @@ def main():
     out['coldstart_time_to_first_step_s_warm'] = -1.0
     out['coldstart_warm_compiles'] = -1
     out['coldstart_error'] = repr(e)[:200]
+
+  try:
+    # Elastic axis (ISSUE 15): the coordinator-led shrink-on-SIGKILL /
+    # grow-on-rejoin ladder — 3 real driver subprocesses on virtual CPU
+    # devices, one killed mid-run, survivors resuming from the artifact
+    # store (elastic_surviving_compiles is the zero-compile contract as
+    # a number), the victim rejoining and the mesh growing back.
+    out.update(_bench_elastic())
+    from tensor2robot_tpu.elastic.axes import ELASTIC_BENCH_KEYS
+    elastic_missing = [key for key in ELASTIC_BENCH_KEYS
+                       if key not in out]
+    if elastic_missing:
+      out['elastic_schema_missing'] = elastic_missing
+  except Exception as e:  # noqa: BLE001
+    out['elastic_recovery_seconds'] = -1.0
+    out['elastic_surviving_compiles'] = -1.0
+    out['elastic_error'] = repr(e)[:200]
 
   try:
     maml_ms, maml_spread = _bench_maml_inner_step(mesh)

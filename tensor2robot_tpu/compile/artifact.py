@@ -49,6 +49,7 @@ import hashlib
 import os
 import pickle
 import tempfile
+import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -420,6 +421,53 @@ def _record_compile(telemetry, registry, workload: str, key: str,
     log_warning('compile telemetry record failed: %s', e)
 
 
+# In-process executable memo: one LOADED executable per artifact file,
+# shared by every later load_or_compile of the same key in this process.
+# An elastic rebuild at the same world shape (elastic/driver.py builds
+# a fresh Trainer per plan epoch) should not re-deserialize a program
+# object this process already holds. NOTE the memo only skips the
+# DESERIALIZATION: with program_key=True (the trainer default) the key
+# itself needs the lowered-program sha, so each load still pays one
+# trace before the memo is consulted — a rebind is trace + lookup, not
+# a pure dictionary hit. An entry is valid only while its backing FILE is
+# the one it was loaded from: every (re-)persist lands via tmp +
+# os.replace, which changes the inode, so the (st_ino, st_size) stamp
+# detects a re-persist by any process (winner moved, drift) while
+# staying immune to the LRU utime touches concurrent hitters apply to a
+# live file.
+_MEMO_LOCK = threading.Lock()
+_LOADED_MEMO: Dict[str, Tuple[Optional[Tuple[int, int]],
+                              'CompiledArtifact']] = {}
+
+
+def _file_stamp(path: str) -> Optional[Tuple[int, int]]:
+  try:
+    stat = os.stat(path)
+    return (stat.st_ino, stat.st_size)
+  except OSError:
+    return None
+
+
+def _memo_get(path: str) -> Optional['CompiledArtifact']:
+  with _MEMO_LOCK:
+    entry = _LOADED_MEMO.get(path)
+  if entry is None:
+    return None
+  stamp, artifact = entry
+  if stamp is not None and _file_stamp(path) != stamp:
+    with _MEMO_LOCK:
+      _LOADED_MEMO.pop(path, None)
+    return None
+  return artifact
+
+
+def _memo_put(path: str, artifact: 'CompiledArtifact') -> None:
+  if not path:
+    return  # never persisted: nothing another process could move
+  with _MEMO_LOCK:
+    _LOADED_MEMO[path] = (_file_stamp(path), artifact)
+
+
 def load_or_compile(workload: str,
                     jitted,
                     example_args,
@@ -477,6 +525,23 @@ def load_or_compile(workload: str,
   config_id = config.config_id if config is not None else 'baseline'
   options = dict(config.compiler_options) if config is not None else {}
 
+  memo_path = store.path_for(key, config_id)
+  memoized = _memo_get(memo_path)
+  if memoized is not None:
+    # Same process, same key, unchanged file: hand back the executable
+    # object already loaded — zero compiles, zero deserializations
+    # (the program-keyed trace above is still paid; see the memo note).
+    # ``drift`` resets: it describes the LOAD EVENT that set it (a
+    # fresh compile disagreeing with a stored fingerprint), not the
+    # executable — replaying it would keep a recovered workload
+    # drift-flagged forever.
+    artifact = dataclasses.replace(memoized, from_cache=True,
+                                   outcome='hit', drift=False)
+    _record_compile(telemetry, registry, workload, key, config_id,
+                    'hit', 'memo', 0.0, artifact.fingerprint, False,
+                    memo_path)
+    return artifact
+
   executable, payload, reason = store.load(key, config_id)
   if executable is not None:
     artifact = CompiledArtifact(
@@ -490,6 +555,7 @@ def load_or_compile(workload: str,
     _record_compile(telemetry, registry, workload, key, config_id,
                     'hit', reason, 0.0, artifact.fingerprint, False,
                     artifact.path)
+    _memo_put(artifact.path, artifact)
     return artifact
 
   # Miss / stale / dead executable: one AOT compile, then persist.
@@ -540,4 +606,5 @@ def load_or_compile(workload: str,
   _record_compile(telemetry, registry, workload, key, config_id,
                   'compiled', reason, compile_s, fingerprint, drift,
                   path)
+  _memo_put(path, artifact)
   return artifact

@@ -1,0 +1,73 @@
+"""Elastic multi-host training: DCN x ICI mesh lifecycle that survives
+losing (and regaining) a host.
+
+ROADMAP item 4's last clause: everything distributed in this repo was
+proven at a FIXED world size (the 8-device dryrun, the subprocess fleet
+sim), while the pjit-era stacks this work measures itself against
+(Scalable Training with pjit on TPUv4, arXiv:2204.06514) treat host
+preemption as routine. This package composes the pieces that already
+exist — the ``host.preempt`` site and ``t2r.recovery.v1`` timeline
+(PR 8), hybrid DCN x ICI mesh construction (``parallel/mesh.py``),
+cooperative Orbax checkpoints, and the ``CompiledArtifact`` store whose
+AOT-as-the-only-path framing (arXiv:1810.09868) was built so N hosts
+share one compile (PR 12) — into a run that keeps training when the
+world changes size:
+
+  * :mod:`~tensor2robot_tpu.elastic.membership` — lease-based
+    membership over the PR 8 fleet files (jax-free): each host renews a
+    lease; the coordinator (lowest surviving index, re-electable)
+    declares a host departed when its lease lapses, distinguishing an
+    orderly leave from a preemption; world membership is published as
+    an epoch-stamped plan every host reads at checkpoint boundaries.
+  * :mod:`~tensor2robot_tpu.elastic.topology` — world size -> mesh
+    plan: DCN x ICI axis factoring, per-host native-loader shard
+    reassignment, and the checkpoint resharding rules that let an
+    Orbax checkpoint written at world N restore at world N-1 or N+1.
+  * :mod:`~tensor2robot_tpu.elastic.driver` — the ``ElasticTrainer``
+    supervisor wrapping the existing ``Trainer``: shrink-on-preemption
+    (emergency save -> mesh rebuild at the smaller world ->
+    artifact-store warm rebind -> resume, one ``t2r.recovery.v1``
+    record carrying ``world_before``/``world_after``) and
+    grow-on-rejoin at the next checkpoint boundary.
+  * :mod:`~tensor2robot_tpu.elastic.axes` — the jax-free subprocess
+    fleet orchestration + ``ELASTIC_BENCH_KEYS`` axes collector behind
+    the MULTICHIP elastic phase and the CPU acceptance run.
+
+``membership`` and ``axes`` import no jax; ``topology``/``driver``
+defer their jax imports into the functions that need them, so importing
+this package stays cheap and jax-free (the ``bin/t2r_telemetry`` /
+CI-gate contract).
+"""
+
+from tensor2robot_tpu.elastic.membership import (  # noqa: F401
+    ELASTIC_SCHEMA,
+    EVENT_COORDINATOR,
+    EVENT_GROW,
+    EVENT_JOIN,
+    EVENT_LEAVE,
+    EVENT_REBUILD,
+    EVENT_SHRINK,
+    EVENT_SHRINK_BEGIN,
+    EVENT_SHRINK_PHASE,
+    LeaseKeeper,
+    MembershipView,
+    SHRINK_PHASES,
+    elect_coordinator,
+    observe,
+    publish_plan,
+    read_leases,
+    read_plan,
+    release_lease,
+    write_lease,
+)
+from tensor2robot_tpu.elastic.topology import (  # noqa: F401
+    MeshPlan,
+    plan_mesh,
+    reshard_plan,
+    shard_assignment,
+)
+from tensor2robot_tpu.elastic.axes import (  # noqa: F401
+    ELASTIC_BENCH_KEYS,
+    collect_axes,
+    run_elastic_fleet,
+)

@@ -1,0 +1,578 @@
+"""ElasticTrainer: the coordinator-led supervisor that survives resizes.
+
+One ``ElasticTrainer`` drives ONE host's participation in an elastic
+run. All hosts share a ``base_dir`` holding the membership leases, the
+world plan, the per-host telemetry streams (``telemetry.<i>.jsonl``,
+the PR 8 fleet layout), the shared compile cache + ``CompiledArtifact``
+store, and one checkpoint tree per host (``base_dir/host<i>``). The
+run proceeds in **boundary segments** (``boundary_steps`` trained steps
+per segment, each ending in a committed checkpoint — the checkpoint
+boundary every membership decision lands on):
+
+  1. join: write a lease (``membership.LeaseKeeper`` renews it in the
+     background), wait for the coordinator's world plan to admit us;
+  2. build: realize the plan's mesh (``topology.build_mesh``), stand up
+     a ``Trainer`` whose train step binds through the shared
+     ``CompiledArtifact`` store (epoch > 1 deserializes what epoch 1
+     persisted — the zero-compile rebuild), restore the newest local
+     checkpoint (or bootstrap from a peer's on first join), and run a
+     one-step probe that closes any pending recovery timeline;
+  3. train a segment; at the boundary the COORDINATOR (lowest active
+     lease, re-electable) compares the plan against the lease table:
+
+       * a member whose lease LAPSED while still ``active`` was
+         preempted -> **shrink**: emergency save, a ``t2r.recovery.v1``
+         marker (the rebuilt trainer's first step closes the timeline,
+         now carrying ``world_before``/``world_after``), a new plan at
+         world N-1, and the ``shrink_begin -> shrink_phase* -> shrink``
+         event ladder every survivor's rebuild is narrated through;
+       * a member that flipped its lease to ``leaving`` departed
+         ORDERLY -> the same shrink ladder, no recovery record (there
+         was no outage) — and the doctor must NOT page host_dead for
+         it (the shrink event is its alibi);
+       * a fresh lease outside the plan is a joiner -> **grow** at this
+         boundary: a new plan at world N+1; every host rebuilds into
+         the larger world (another store hit — growing compiles
+         nothing either).
+
+  4. every host re-reads the plan at each boundary and rebuilds
+     whenever the epoch moved; otherwise it just keeps training.
+
+The CLI form (``python -m tensor2robot_tpu.elastic.driver``) is what
+the subprocess federation runs (tests/test_elastic.py, the MULTICHIP
+elastic phase via :mod:`~tensor2robot_tpu.elastic.axes`): each host is
+a real OS process with its own jax runtime, sharing only the filesystem
+— the same harness discipline as ``observability/fleet_sim.py``, with
+real training inside.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from tensor2robot_tpu.elastic import membership
+from tensor2robot_tpu.elastic import topology
+from tensor2robot_tpu.reliability import fault_injection
+
+__all__ = ['ElasticConfig', 'ElasticTrainer', 'maybe_stall_rebuild',
+           'main']
+
+
+def maybe_stall_rebuild() -> float:
+  """The ``elastic.rebuild`` fault site: seconds THIS rebuild stalls.
+
+  A wedged mesh rebuild (hung device init, a peer stuck in a barrier)
+  is the elastic failure mode the doctor's stuck-rebuild rule pages on;
+  this site stages it deterministically (docs/reliability.md).
+  """
+  seconds = fault_injection.elastic_rebuild_stall_seconds()
+  if seconds > 0.0:
+    time.sleep(seconds)
+  return seconds
+
+
+class ElasticConfig:
+  """Knobs of one elastic run (shared by every host of the run).
+
+  ``lease_ttl_secs`` must comfortably exceed ``renew_secs`` plus the
+  longest boundary segment, or a merely-slow host reads as preempted.
+  ``boundary_steps`` is both the segment length and the checkpoint
+  cadence — membership changes only land on these boundaries.
+  ``stop_file`` names a file whose appearance asks every host to leave
+  orderly (how the test harness ends an open-ended run).
+  """
+
+  def __init__(self,
+               target_world: int,
+               min_world: Optional[int] = None,
+               lease_ttl_secs: float = 6.0,
+               renew_secs: float = 1.0,
+               boundary_steps: int = 2,
+               poll_secs: float = 0.25,
+               max_run_seconds: float = 300.0,
+               per_host_batch: int = 8,
+               use_fsdp: bool = True,
+               stop_file: Optional[str] = None,
+               use_compiled_artifacts: bool = True,
+               artifact_workload: str = 'elastic_step'):
+    self.target_world = int(target_world)
+    self.min_world = int(min_world if min_world is not None
+                         else target_world)
+    self.lease_ttl_secs = float(lease_ttl_secs)
+    self.renew_secs = float(renew_secs)
+    self.boundary_steps = max(1, int(boundary_steps))
+    self.poll_secs = float(poll_secs)
+    self.max_run_seconds = float(max_run_seconds)
+    self.per_host_batch = int(per_host_batch)
+    self.use_fsdp = bool(use_fsdp)
+    self.stop_file = stop_file
+    self.use_compiled_artifacts = bool(use_compiled_artifacts)
+    self.artifact_workload = artifact_workload
+
+
+class ElasticTrainer:
+  """One host's elastic supervisor (see module docstring).
+
+  ``model_factory``/``generator_factory`` are zero-arg callables so the
+  heavy objects are built only once jax is configured;
+  ``trainer_kwargs`` forwards extra knobs into every per-epoch Trainer.
+  """
+
+  def __init__(self,
+               model_factory: Callable[[], Any],
+               generator_factory: Callable[[], Any],
+               base_dir: str,
+               host: int,
+               config: ElasticConfig,
+               trainer_kwargs: Optional[Dict[str, Any]] = None):
+    self.model_factory = model_factory
+    self.generator_factory = generator_factory
+    self.base_dir = base_dir
+    self.host = int(host)
+    self.config = config
+    self.trainer_kwargs = dict(trainer_kwargs or {})
+    self.host_dir = os.path.join(base_dir, 'host{}'.format(self.host))
+    self.preempted = False
+    self._telemetry = None
+    self._identity: Optional[Dict[str, object]] = None
+    self._model = None
+    self._generator = None
+    self._pending_shrink: Optional[Dict[str, object]] = None
+    self._mesh_plan: Optional[topology.MeshPlan] = None
+    self._announced_coordinator = False
+
+  # -- shared lazy state -----------------------------------------------------
+
+  @property
+  def identity(self) -> Dict[str, object]:
+    """This host's fleet identity: the ELASTIC coordinates, not jax's.
+
+    Each simulated host is its own jax world (``jax.process_index()``
+    is 0 everywhere on the CPU federation), so the elastic host index /
+    target world REPLACE the jax coordinates in the telemetry stamp —
+    which is exactly what routes each host to its own
+    ``telemetry.<host>.jsonl`` under the shared base_dir.
+    """
+    if self._identity is None:
+      from tensor2robot_tpu.observability import signals as signals_lib
+      identity = signals_lib.host_identity()
+      identity['process_index'] = self.host
+      identity['process_count'] = max(self.config.target_world, 2)
+      self._identity = identity
+    return self._identity
+
+  @property
+  def telemetry(self):
+    if self._telemetry is None:
+      from tensor2robot_tpu.observability import TelemetryLogger
+      self._telemetry = TelemetryLogger(self.base_dir,
+                                        host_meta=self.identity)
+    return self._telemetry
+
+  def _log_event(self, event: str, step: int, **fields) -> None:
+    record = membership.elastic_record(event, host=self.host, **fields)
+    self.telemetry.log('elastic', step=step, **record)
+    self.telemetry.flush()
+
+  def _stop_requested(self) -> bool:
+    return bool(self.config.stop_file
+                and os.path.exists(self.config.stop_file))
+
+  def _make_plan(self, plan: Dict[str, object]) -> topology.MeshPlan:
+    import jax
+    return topology.plan_mesh(
+        int(plan['world_size']), len(jax.local_devices()),
+        self.config.per_host_batch, use_fsdp=self.config.use_fsdp,
+        epoch=int(plan['epoch']), hosts=plan['hosts'])
+
+  # -- coordinator duties ----------------------------------------------------
+
+  def _coordinate(self, view: membership.MembershipView,
+                  plan: Optional[Dict[str, object]], step: int,
+                  trainer, state) -> Optional[Dict[str, object]]:
+    """One boundary's coordinator pass: publish/adjust the world plan."""
+    if plan is None:
+      if len(view.active) < self.config.min_world:
+        return None
+      plan = membership.publish_plan(self.base_dir, 1, view.active,
+                                     boundary_step=step,
+                                     coordinator=self.host)
+      self._log_event(membership.EVENT_GROW, step, epoch=1,
+                      world_before=0, world_after=len(view.active),
+                      joined=list(view.active))
+      return plan
+    if int(plan.get('coordinator', -1)) != self.host \
+        and not self._announced_coordinator:
+      # Re-election: the planned coordinator's lease is no longer the
+      # lowest active one (it died or left) — announce the handover
+      # once; the shrink that removes it follows below.
+      self._announced_coordinator = True
+      self._log_event(membership.EVENT_COORDINATOR, step,
+                      previous=plan.get('coordinator'))
+    members = set(int(h) for h in plan.get('hosts') or [])
+    lapsed = sorted(members & set(view.lapsed))
+    leaving = sorted(members & set(view.leaving))
+    joiners = sorted(set(view.active) - members)
+    if lapsed or leaving:
+      return self._declare_shrink(view, plan, step, lapsed, leaving,
+                                  trainer, state)
+    if joiners:
+      epoch = int(plan['epoch']) + 1
+      hosts = sorted(members | set(joiners))
+      new_plan = membership.publish_plan(self.base_dir, epoch, hosts,
+                                         boundary_step=step,
+                                         coordinator=self.host)
+      old = self._mesh_plan or self._make_plan(plan)
+      self._log_event(
+          membership.EVENT_GROW, step, epoch=epoch,
+          world_before=len(members), world_after=len(hosts),
+          joined=joiners,
+          reshard=topology.reshard_plan(old, self._make_plan(new_plan)))
+      return new_plan
+    return plan
+
+  def _declare_shrink(self, view: membership.MembershipView,
+                      plan: Dict[str, object], step: int,
+                      lapsed, leaving, trainer, state
+                      ) -> Dict[str, object]:
+    """The shrink ladder's coordinator half: save -> marker -> new plan.
+
+    The remaining rungs (mesh_rebuild, artifact_rebind, the terminal
+    ``shrink`` event and — for a preemption — the recovery record) land
+    in ``_rebuild``, which every survivor runs when it sees the new
+    epoch; only the coordinator narrates them.
+    """
+    from tensor2robot_tpu.observability import fleet as fleet_lib
+
+    departed = sorted(set(lapsed) | set(leaving))
+    orderly = not lapsed
+    members = [int(h) for h in plan.get('hosts') or []]
+    world_before = len(members)
+    # Survivors = plan members minus the departed, PLUS every host with
+    # a fresh active lease: a coordinator re-elected from outside the
+    # plan (the old one died before admitting it) and any joiner racing
+    # the shrink fold in here instead of being orphaned — and the world
+    # can never shrink to zero while someone is alive to declare it.
+    survivors = sorted((set(members) - set(departed)) | set(view.active))
+    epoch = int(plan['epoch']) + 1
+    self._log_event(membership.EVENT_SHRINK_BEGIN, step, epoch=epoch,
+                    world_before=world_before,
+                    world_after=len(survivors), departed=departed,
+                    orderly=orderly, lapsed=lapsed, leaving=leaving)
+    save_t0 = time.perf_counter()
+    if trainer is not None and state is not None:
+      try:
+        trainer.save_checkpoint(state, force=True)
+        trainer.checkpoint_manager.wait_until_finished()
+      except Exception as e:  # noqa: BLE001 — a failed extra save must
+        # not kill the shrink: the boundary checkpoint already committed.
+        self._log_event(membership.EVENT_SHRINK_PHASE, step, epoch=epoch,
+                        phase='emergency_save', error=str(e))
+    save_s = time.perf_counter() - save_t0
+    self._log_event(membership.EVENT_SHRINK_PHASE, step, epoch=epoch,
+                    phase='emergency_save', seconds=save_s)
+    if not orderly:
+      # The preemption timeline: the marker the REBUILT trainer consumes
+      # at its first completed step, closing t2r.recovery.v1 with
+      # phases that sum to the outage — now carrying the world change.
+      fleet_lib.write_recovery_marker(
+          self.host_dir, step, membership.ELASTIC_LAPSE_SIGNUM, save_s,
+          process_index=self.host, world_before=world_before,
+          world_after=len(survivors), departed=departed, elastic=True)
+    new_plan = membership.publish_plan(self.base_dir, epoch, survivors,
+                                       boundary_step=step,
+                                       coordinator=self.host)
+    old = self._mesh_plan or self._make_plan(plan)
+    self._pending_shrink = {
+        'epoch': epoch, 'world_before': world_before,
+        'world_after': len(survivors), 'departed': departed,
+        'orderly': orderly,
+        'reshard': topology.reshard_plan(old, self._make_plan(new_plan)),
+    }
+    return new_plan
+
+  # -- build/rebuild ---------------------------------------------------------
+
+  def _bootstrap_state(self, trainer, plan: Dict[str, object]):
+    """First-join bootstrap: restore a PEER's checkpoint into MY tree.
+
+    The checkpoint-resharding story made concrete: a checkpoint written
+    at world N (under the old mesh) restores through a template built
+    on THIS epoch's mesh — Orbax lays the unchanged global arrays onto
+    the new device set. Returns a TrainState, or None when there is
+    nothing to bootstrap from (a genuinely fresh run) or a local
+    checkpoint already exists (the normal restore path handles it).
+    """
+    if trainer.checkpoint_manager.all_steps():
+      return None
+    peers = [int(h) for h in plan.get('hosts') or []
+             if int(h) != self.host]
+    source = None
+    for peer in sorted(peers):
+      peer_dir = os.path.join(self.base_dir, 'host{}'.format(peer))
+      if os.path.isdir(peer_dir):
+        source = peer_dir
+        break
+    if source is None:
+      return None
+    try:
+      from tensor2robot_tpu.trainer import Trainer
+      from tensor2robot_tpu.trainer.train_eval import (
+          provide_input_generator_with_model_information,
+      )
+      from tensor2robot_tpu.modes import ModeKeys
+
+      generator = provide_input_generator_with_model_information(
+          self._generator, self._model, ModeKeys.TRAIN)
+      features, labels = next(generator.create_dataset_iterator(
+          mode=ModeKeys.TRAIN))
+      # A read-only probe trainer over the PEER's tree: same model, THIS
+      # epoch's mesh, no quarantine, no writers.
+      probe = Trainer(self._model, source, mesh=trainer.mesh,
+                      use_fsdp=trainer.use_fsdp, async_checkpoints=False,
+                      write_metrics=False, owns_checkpoint_dir=False,
+                      enable_fleet=False, auto_profile=False,
+                      save_checkpoints_steps=10**9,
+                      log_every_n_steps=10**9)
+      try:
+        if not probe.checkpoint_manager.all_steps():
+          return None
+        state = probe.init_state(features, labels)
+      finally:
+        probe.close()
+      return state
+    except Exception as e:  # noqa: BLE001 — bootstrap is best-effort: a
+      # fresh init is always a valid (if colder) join.
+      self._log_event(membership.EVENT_REBUILD, 0,
+                      epoch=int(plan['epoch']), bootstrap_error=str(e))
+      return None
+
+  def _rebuild(self, plan: Dict[str, object], old_trainer, registry):
+    """Mesh + trainer rebuild for a new plan epoch, plus the one-step
+    probe that binds the artifact store and closes any pending recovery
+    timeline. Returns ``(trainer, state)``."""
+    import jax
+
+    from tensor2robot_tpu.trainer import Trainer
+
+    shrink = self._pending_shrink
+    epoch = int(plan['epoch'])
+    if old_trainer is not None:
+      old_trainer.close()
+    rebuild_t0 = time.perf_counter()
+    maybe_stall_rebuild()
+    mesh_plan = self._make_plan(plan)
+    self._mesh_plan = mesh_plan
+    mesh = topology.build_mesh(mesh_plan)
+    kwargs = dict(
+        mesh=mesh, use_fsdp=mesh_plan.use_fsdp, async_checkpoints=False,
+        save_checkpoints_steps=10**9,
+        log_every_n_steps=self.config.boundary_steps,
+        enable_fleet=False, auto_profile=False,
+        use_compiled_artifacts=self.config.use_compiled_artifacts,
+        artifact_workload=self.config.artifact_workload,
+        tuning_cache_path=os.path.join(self.base_dir,
+                                       'compile_cache.json'),
+        shared_telemetry=self.telemetry,
+        host_identity=self.identity)
+    kwargs.update(self.trainer_kwargs)
+    trainer = Trainer(self._model, self.host_dir, **kwargs)
+    rebuild_s = time.perf_counter() - rebuild_t0
+    if shrink is not None:
+      self._log_event(membership.EVENT_SHRINK_PHASE, 0,
+                      epoch=shrink['epoch'], phase='mesh_rebuild',
+                      seconds=rebuild_s)
+    state = self._bootstrap_state(trainer, plan)
+    # One-step probe: binds the train step through the artifact store
+    # (epoch > 1 must deserialize — the zero-compile rebuild) and, on
+    # the coordinator's preemption path, consumes the recovery marker so
+    # the t2r.recovery.v1 record closes on a genuinely trained step.
+    rank, world = topology.shard_assignment(mesh_plan, self.host)
+    latest = trainer.checkpoint_manager.latest_step()
+    if state is not None:
+      latest = int(jax.device_get(state.step))
+    start = int(latest or 0)
+    compiles_before = float(
+        registry.scalars().get('jax/compiles', 0.0))
+    state = trainer.train(self._generator, max_train_steps=start + 1,
+                          state=state, shard_index=rank,
+                          num_shards=world)
+    compiles_delta = float(
+        registry.scalars().get('jax/compiles', 0.0)) - compiles_before
+    artifact = getattr(trainer, '_train_step_artifact', None)
+    outcome = 'none'
+    if artifact is not None:
+      outcome = 'hit' if getattr(artifact, 'from_cache', False) else 'miss'
+    step = int(jax.device_get(state.step))
+    self._log_event(membership.EVENT_REBUILD, step, epoch=epoch,
+                    world_size=mesh_plan.world_size, rank=rank,
+                    artifact_outcome=outcome,
+                    compiles_delta=compiles_delta)
+    if shrink is not None:
+      self._log_event(membership.EVENT_SHRINK_PHASE, step,
+                      epoch=shrink['epoch'], phase='artifact_rebind',
+                      artifact_outcome=outcome,
+                      compiles_delta=compiles_delta)
+      recovery_s = None
+      if not shrink.get('orderly'):
+        from tensor2robot_tpu.observability import fleet as fleet_lib
+        value = registry.gauge(fleet_lib.RECOVERY_GAUGE).value
+        recovery_s = value if value > 0.0 else None
+      self._log_event(membership.EVENT_SHRINK, step, **dict(
+          shrink, recovery_seconds=recovery_s))
+      self._pending_shrink = None
+    return trainer, state
+
+  # -- the run ---------------------------------------------------------------
+
+  def run(self, total_steps: int):
+    """Participates until ``total_steps``, a stop request, preemption,
+    or ``max_run_seconds``; returns the last trained step."""
+    import jax
+
+    from tensor2robot_tpu.observability import get_registry
+    from tensor2robot_tpu.reliability.errors import TrainingPreempted
+
+    config = self.config
+    registry = get_registry()
+    deadline = time.monotonic() + config.max_run_seconds
+    self._model = self.model_factory()
+    self._generator = self.generator_factory()
+    # A previous incarnation that died through the injected host.preempt
+    # path left its own recovery marker behind. In an elastic run the
+    # COORDINATOR's shrink record is the one t2r.recovery.v1 account of
+    # that outage — consuming the stale marker here keeps "exactly one
+    # record per preemption" true across the victim's rejoin.
+    from tensor2robot_tpu.observability import fleet as fleet_lib
+    fleet_lib.consume_recovery_marker(self.host_dir,
+                                      process_index=self.host)
+    keeper = membership.LeaseKeeper(self.base_dir, self.host,
+                                    renew_secs=config.renew_secs)
+    keeper.start()
+    self._log_event(membership.EVENT_JOIN, 0,
+                    incarnation=keeper.incarnation,
+                    target_world=config.target_world)
+    trainer = None
+    state = None
+    built_epoch = None
+    step = 0
+    try:
+      while time.monotonic() < deadline:
+        if self._stop_requested():
+          break
+        view = membership.observe(self.base_dir, config.lease_ttl_secs)
+        plan = membership.read_plan(self.base_dir)
+        if membership.elect_coordinator(view) == self.host:
+          plan = self._coordinate(view, plan, step, trainer, state)
+        if plan is None or self.host not in [
+            int(h) for h in plan.get('hosts') or []]:
+          time.sleep(config.poll_secs)
+          continue
+        if built_epoch != int(plan['epoch']):
+          trainer, state = self._rebuild(plan, trainer, registry)
+          built_epoch = int(plan['epoch'])
+          step = int(jax.device_get(state.step))
+          continue  # fresh boundary: re-observe before the next segment
+        if step >= total_steps:
+          break
+        boundary = config.boundary_steps
+        target = min((step // boundary + 1) * boundary, total_steps)
+        rank, world = topology.shard_assignment(self._mesh_plan,
+                                                self.host)
+        state = trainer.train(self._generator, max_train_steps=target,
+                              state=state, shard_index=rank,
+                              num_shards=world)
+        step = int(jax.device_get(state.step))
+    except TrainingPreempted:
+      # The injected host.preempt path: die like a preempted host —
+      # no orderly leave, the lease lapses, the coordinator shrinks.
+      self.preempted = True
+    finally:
+      keeper.stop(orderly=not self.preempted)
+      if not self.preempted:
+        self._log_event(membership.EVENT_LEAVE, step,
+                        incarnation=keeper.incarnation)
+      if trainer is not None:
+        trainer.close()
+      if self._telemetry is not None:
+        self._telemetry.close()
+    return step
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(description=__doc__)
+  parser.add_argument('--base_dir', required=True)
+  parser.add_argument('--host', type=int, required=True)
+  parser.add_argument('--world', type=int, default=3,
+                      help='target world size (min_world defaults to it)')
+  parser.add_argument('--min_world', type=int, default=None)
+  parser.add_argument('--total_steps', type=int, default=10**6)
+  parser.add_argument('--boundary_steps', type=int, default=2)
+  parser.add_argument('--per_host_batch', type=int, default=8)
+  parser.add_argument('--local_device_count', type=int, default=4)
+  parser.add_argument('--lease_ttl_secs', type=float, default=6.0)
+  parser.add_argument('--renew_secs', type=float, default=1.0)
+  parser.add_argument('--max_run_seconds', type=float, default=300.0)
+  parser.add_argument('--stop_file', default=None)
+  parser.add_argument('--no_fsdp', action='store_true')
+  parser.add_argument('--no_artifacts', action='store_true')
+  parser.add_argument('--inject_preempt_after', type=int, default=None,
+                      help='arm the host.preempt FaultInjector site to '
+                      'fire after N trainer-loop passes (the injected '
+                      'alternative to SIGKILL)')
+  parser.add_argument('--rebuild_stall_secs', type=float, default=None,
+                      help='arm the elastic.rebuild site with this '
+                      'stall on the next rebuild')
+  args = parser.parse_args(argv)
+
+  # Device virtualization + platform pinning BEFORE the first jax import
+  # (the multihost.py / conftest discipline).
+  os.environ['JAX_PLATFORMS'] = 'cpu'
+  flags = os.environ.get('XLA_FLAGS', '')
+  if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count={}'.format(
+            args.local_device_count)).strip()
+
+  injector = None
+  if args.inject_preempt_after is not None:
+    injector = fault_injection.FaultInjector().fail(
+        fault_injection.SITE_HOST_PREEMPT, times=1,
+        after=args.inject_preempt_after)
+  if args.rebuild_stall_secs is not None:
+    fault_injection.ELASTIC_REBUILD_STALL_SECONDS = args.rebuild_stall_secs
+    injector = (injector or fault_injection.FaultInjector()).fail(
+        fault_injection.SITE_ELASTIC_REBUILD, times=1)
+  if injector is not None:
+    fault_injection.set_injector(injector)
+
+  def model_factory():
+    from tensor2robot_tpu.utils.mocks import MockT2RModel
+    return MockT2RModel(device_type='cpu')
+
+  def generator_factory():
+    from tensor2robot_tpu.utils.mocks import MockInputGenerator
+    return MockInputGenerator(batch_size=args.per_host_batch)
+
+  config = ElasticConfig(
+      target_world=args.world, min_world=args.min_world,
+      lease_ttl_secs=args.lease_ttl_secs, renew_secs=args.renew_secs,
+      boundary_steps=args.boundary_steps,
+      max_run_seconds=args.max_run_seconds,
+      per_host_batch=args.per_host_batch, use_fsdp=not args.no_fsdp,
+      stop_file=args.stop_file,
+      use_compiled_artifacts=not args.no_artifacts)
+  elastic = ElasticTrainer(model_factory, generator_factory,
+                           args.base_dir, args.host, config)
+  step = elastic.run(args.total_steps)
+  print('elastic host {} done at step {}{}'.format(
+      args.host, step, ' (preempted)' if elastic.preempted else ''))
+  return 0
+
+
+if __name__ == '__main__':
+  import sys
+  sys.exit(main())

@@ -1,0 +1,306 @@
+"""Lease-based elastic membership over the PR 8 fleet-file layout.
+
+The contract is FILES, not collectives (the same decision that made the
+fleet observatory testable without ``jax.distributed``): every host in
+an elastic run shares one ``base_dir`` and
+
+  * renews a **lease** — ``lease.<host>.json``, atomically replaced —
+    every ``renew_secs``; a lease whose wall-clock stamp is older than
+    ``lease_ttl_secs`` has LAPSED (the host is presumed preempted or
+    partitioned — the distinction from an orderly departure is the
+    ``status`` field: a host that means to leave rewrites its lease as
+    ``status='leaving'`` first, the same orderly-vs-dead split the
+    fleet watchdog's ``host_dead`` latch draws from heartbeats);
+  * reads the **world plan** — ``world_plan.json``, written only by the
+    coordinator — at every checkpoint boundary. The plan is
+    epoch-stamped; an epoch change is the rebuild signal (new mesh, new
+    shard assignment, new trainer bound from the artifact store).
+
+The **coordinator** is the lowest-indexed host holding a fresh active
+lease. It is re-electable by construction: if host 0 dies, host 1's
+``elect_coordinator`` answer changes on its next observation and it
+takes over publishing (emitting an ``EVENT_COORDINATOR`` record so the
+handover is visible in telemetry).
+
+Membership changes are narrated into the shared telemetry stream as
+``kind='elastic'`` records (``t2r.elastic.v1``):
+
+  * ``join`` / ``leave``          — per-host lifecycle;
+  * ``coordinator``               — a re-election;
+  * ``shrink_begin``              — the coordinator declared hosts
+    departed (``departed``, ``orderly``, ``world_before/after``);
+  * ``shrink_phase``              — one completed rung of the shrink
+    ladder (``SHRINK_PHASES``: emergency_save -> mesh_rebuild ->
+    artifact_rebind), each with its measured seconds;
+  * ``shrink``                    — the ladder completed and training
+    resumed at the smaller world;
+  * ``grow``                      — the plan re-admitted host(s) at a
+    checkpoint boundary (``joined``, ``world_before/after``);
+  * ``rebuild``                   — one host finished rebuilding for a
+    new epoch (its artifact-store outcome + XLA-compile delta: the
+    per-host zero-compile evidence).
+
+Everything here is jax-free; wall-clock reads appear only for stamps
+that cross process boundaries (leases, plans) and are annotated per the
+``tests/test_no_wallclock.py`` contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ['ELASTIC_SCHEMA', 'EVENT_JOIN', 'EVENT_LEAVE',
+           'EVENT_COORDINATOR', 'EVENT_SHRINK_BEGIN', 'EVENT_SHRINK_PHASE',
+           'EVENT_SHRINK', 'EVENT_GROW', 'EVENT_REBUILD', 'SHRINK_PHASES',
+           'ELASTIC_LAPSE_SIGNUM', 'LEASE_FILE', 'PLAN_FILE',
+           'MembershipView', 'LeaseKeeper', 'write_lease', 'read_leases',
+           'release_lease', 'observe', 'elect_coordinator', 'publish_plan',
+           'read_plan', 'elastic_record']
+
+ELASTIC_SCHEMA = 't2r.elastic.v1'
+
+EVENT_JOIN = 'join'
+EVENT_LEAVE = 'leave'
+EVENT_COORDINATOR = 'coordinator'
+EVENT_SHRINK_BEGIN = 'shrink_begin'
+EVENT_SHRINK_PHASE = 'shrink_phase'
+EVENT_SHRINK = 'shrink'
+EVENT_GROW = 'grow'
+EVENT_REBUILD = 'rebuild'
+
+# The shrink ladder, in order. Doctor's stuck-rebuild rule names the
+# FIRST rung missing after a shrink_begin as the stalled phase ('resume'
+# when every rung completed but the terminal 'shrink' never landed).
+SHRINK_PHASES = ('emergency_save', 'mesh_rebuild', 'artifact_rebind')
+
+# Signum stamped into recovery records whose "signal" was a lease lapse
+# observed by the coordinator (no signal was ever delivered anywhere —
+# the departed host just stopped renewing). -1 is the injected
+# host.preempt signum (fault_injection.INJECTED_PREEMPT_SIGNUM).
+ELASTIC_LAPSE_SIGNUM = -2
+
+LEASE_FILE = 'lease.{}.json'
+PLAN_FILE = 'world_plan.json'
+
+
+def lease_path(base_dir: str, host: int) -> str:
+  return os.path.join(base_dir, LEASE_FILE.format(int(host)))
+
+
+def plan_path(base_dir: str) -> str:
+  return os.path.join(base_dir, PLAN_FILE)
+
+
+def _write_atomic(path: str, payload: Dict[str, object]) -> str:
+  tmp = '{}.tmp.{}'.format(path, os.getpid())
+  with open(tmp, 'w', encoding='utf-8') as f:
+    json.dump(payload, f)
+  os.replace(tmp, path)
+  return path
+
+
+def _read_json(path: str) -> Optional[Dict[str, object]]:
+  if not os.path.exists(path):
+    return None
+  try:
+    with open(path, encoding='utf-8') as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return None  # mid-replace race / torn tmp: treat as absent this read
+
+
+def write_lease(base_dir: str, host: int, incarnation: int = 1,
+                status: str = 'active',
+                now: Optional[float] = None) -> str:
+  """Atomically (re)writes one host's lease.
+
+  ``now`` overrides the stamp — fixtures backdate it to simulate a
+  lapse without waiting out a TTL.
+  """
+  if status not in ('active', 'leaving'):
+    raise ValueError('lease status must be active|leaving; got '
+                     '{!r}.'.format(status))
+  os.makedirs(base_dir, exist_ok=True)
+  lease = {
+      'time': time.time() if now is None else float(now),  # wall-clock: cross-process freshness stamp
+      'host': int(host),
+      'incarnation': int(incarnation),
+      'status': status,
+      'pid': os.getpid(),
+  }
+  return _write_atomic(lease_path(base_dir, host), lease)
+
+
+def release_lease(base_dir: str, host: int,
+                  incarnation: int = 1) -> str:
+  """Marks an ORDERLY departure: the lease flips to ``status='leaving'``.
+
+  The file stays on disk deliberately — it is the evidence the
+  coordinator (and doctor) use to classify the departure as orderly
+  rather than a preemption.
+  """
+  return write_lease(base_dir, host, incarnation=incarnation,
+                     status='leaving')
+
+
+def read_leases(base_dir: str) -> Dict[int, Dict[str, object]]:
+  """All readable leases under ``base_dir`` keyed by host index."""
+  leases: Dict[int, Dict[str, object]] = {}
+  try:
+    names = sorted(os.listdir(base_dir))
+  except OSError:
+    return leases
+  for name in names:
+    if not (name.startswith('lease.') and name.endswith('.json')):
+      continue
+    middle = name[len('lease.'):-len('.json')]
+    if not middle.isdigit():
+      continue
+    lease = _read_json(os.path.join(base_dir, name))
+    if lease is not None:
+      leases[int(middle)] = lease
+  return leases
+
+
+class MembershipView:
+  """One observation of the lease table: who is active/leaving/lapsed."""
+
+  def __init__(self, active: Sequence[int], leaving: Sequence[int],
+               lapsed: Sequence[int],
+               leases: Dict[int, Dict[str, object]]):
+    self.active = tuple(sorted(int(h) for h in active))
+    self.leaving = tuple(sorted(int(h) for h in leaving))
+    self.lapsed = tuple(sorted(int(h) for h in lapsed))
+    self.leases = dict(leases)
+
+  @property
+  def coordinator(self) -> Optional[int]:
+    return self.active[0] if self.active else None
+
+  def __repr__(self):
+    return ('MembershipView(active={}, leaving={}, lapsed={})'
+            .format(self.active, self.leaving, self.lapsed))
+
+
+def observe(base_dir: str, lease_ttl_secs: float,
+            now: Optional[float] = None) -> MembershipView:
+  """Classifies every lease as active (fresh), leaving (orderly
+  departure announced), or lapsed (stale while still claiming active —
+  the preemption signature)."""
+  if now is None:
+    now = time.time()  # wall-clock: compared to cross-process lease stamps
+  leases = read_leases(base_dir)
+  active: List[int] = []
+  leaving: List[int] = []
+  lapsed: List[int] = []
+  for host, lease in leases.items():
+    if lease.get('status') == 'leaving':
+      leaving.append(host)
+    elif float(now) - float(lease.get('time', 0.0)) <= lease_ttl_secs:
+      active.append(host)
+    else:
+      lapsed.append(host)
+  return MembershipView(active, leaving, lapsed, leases)
+
+
+def elect_coordinator(view: MembershipView) -> Optional[int]:
+  """Lowest-indexed host with a fresh active lease (None: nobody)."""
+  return view.coordinator
+
+
+def publish_plan(base_dir: str, epoch: int, hosts: Sequence[int],
+                 boundary_step: int = 0,
+                 coordinator: Optional[int] = None) -> Dict[str, object]:
+  """Atomically publishes the world plan (coordinator-only by protocol).
+
+  ``hosts`` become the world; ``ranks`` assigns each its dense data
+  rank (the native-loader shard index at this epoch).
+  """
+  hosts = sorted(int(h) for h in hosts)
+  plan = {
+      'epoch': int(epoch),
+      'world_size': len(hosts),
+      'hosts': hosts,
+      'ranks': {str(host): rank for rank, host in enumerate(hosts)},
+      'boundary_step': int(boundary_step),
+      'coordinator': int(coordinator if coordinator is not None
+                         else (hosts[0] if hosts else -1)),
+      'time': time.time(),  # wall-clock: cross-process plan stamp
+  }
+  _write_atomic(plan_path(base_dir), plan)
+  return plan
+
+
+def read_plan(base_dir: str) -> Optional[Dict[str, object]]:
+  return _read_json(plan_path(base_dir))
+
+
+def plan_rank(plan: Dict[str, object], host: int) -> Optional[int]:
+  rank = (plan.get('ranks') or {}).get(str(int(host)))
+  return None if rank is None else int(rank)
+
+
+def elastic_record(event: str, **fields) -> Dict[str, object]:
+  """The ``t2r.elastic.v1`` payload for one membership event."""
+  record: Dict[str, object] = {'schema': ELASTIC_SCHEMA, 'event': event}
+  record.update(fields)
+  return record
+
+
+class LeaseKeeper:
+  """Background lease renewal for one host (daemon thread).
+
+  Renews every ``renew_secs`` until stopped; ``stop(orderly=True)``
+  flips the lease to ``status='leaving'`` (the orderly-departure
+  evidence), ``stop(orderly=False)`` just stops renewing — the lease
+  then lapses naturally, which is how tests simulate a preemption
+  without SIGKILL. Renewal pacing uses the monotonic clock (a
+  wall-clock jump must not let a healthy host's lease lapse); only the
+  STAMP written into the file is wall-clock.
+  """
+
+  def __init__(self, base_dir: str, host: int, renew_secs: float = 2.0,
+               incarnation: Optional[int] = None):
+    self.base_dir = base_dir
+    self.host = int(host)
+    self.renew_secs = float(renew_secs)
+    if incarnation is None:
+      previous = read_leases(base_dir).get(self.host)
+      incarnation = int((previous or {}).get('incarnation', 0)) + 1
+    self.incarnation = int(incarnation)
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  def start(self) -> 'LeaseKeeper':
+    write_lease(self.base_dir, self.host, incarnation=self.incarnation)
+    self._thread = threading.Thread(target=self._run, daemon=True,
+                                    name='lease-keeper-{}'.format(self.host))
+    self._thread.start()
+    return self
+
+  def _run(self) -> None:
+    next_renew = time.monotonic() + self.renew_secs
+    while not self._stop.wait(timeout=max(next_renew - time.monotonic(),
+                                          0.05)):
+      next_renew = time.monotonic() + self.renew_secs
+      try:
+        write_lease(self.base_dir, self.host,
+                    incarnation=self.incarnation)
+      except OSError:
+        pass  # transient filesystem blip: the next renewal retries
+
+  def stop(self, orderly: bool = True) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=5.0)
+      self._thread = None
+    if orderly:
+      try:
+        release_lease(self.base_dir, self.host,
+                      incarnation=self.incarnation)
+      except OSError:
+        pass

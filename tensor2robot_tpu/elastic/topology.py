@@ -1,0 +1,169 @@
+"""World size -> mesh plan: DCN x ICI factoring, shards, resharding.
+
+The planner answers the three questions an elastic run re-asks every
+time the world changes size (Scalable Training with pjit on TPUv4,
+arXiv:2204.06514 — the DCN x ICI layout this repo's
+``parallel.create_hybrid_mesh`` builds):
+
+  * **Mesh** — how do W hosts x D local devices factor into the
+    flagship data x fsdp combo? DCN (cross-host) axes stay data-only —
+    gradient psums then decompose into an ICI reduce-scatter plus a
+    small DCN all-reduce, keeping the slow hops at O(params/host)
+    bytes — while fsdp stays ICI-local. ``build_mesh`` returns the
+    hybrid mesh when this process really spans the world
+    (``jax.process_count() == world_size``) and the per-host local
+    mesh otherwise (the CPU subprocess federation, where the DCN axis
+    is carried by the plan: each simulated host owns its local slice
+    and the cross-host axis lives in shard assignment + the shared
+    checkpoint/artifact stores).
+  * **Shards** — which slice of the input files does each host read?
+    Dense ranks over the plan's sorted member list: host ranks are
+    REASSIGNED on every epoch, so after a shrink the survivors re-cover
+    the departed host's shard residue (the PER_HOST_V2 contract,
+    ``Trainer.train(shard_index=, num_shards=)``).
+  * **Checkpoints** — why does a checkpoint written at world N restore
+    at world N±1? Orbax checkpoints store GLOBAL arrays; the restore
+    template (``Trainer.init_state``) carries the NEW mesh's shardings,
+    so the same global leaves are simply laid out onto the new device
+    set. What changes is captured by ``reshard_plan``: the global batch
+    (per-host batch x world) and the shard map — never the parameter
+    tree. That invariant is what makes shrink/grow a restore, not a
+    migration.
+
+Import-light: jax is deferred into ``build_mesh`` so the doctor / CI
+gates can import the planner's vocabulary (``ELASTIC_BENCH_KEYS`` lives
+in :mod:`~tensor2robot_tpu.elastic.axes`) without a jax install.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ['MeshPlan', 'plan_mesh', 'build_mesh', 'shard_assignment',
+           'reshard_plan']
+
+
+class MeshPlan:
+  """One epoch's world -> mesh factoring (plain data, jax-free)."""
+
+  def __init__(self, world_size: int, local_device_count: int,
+               per_host_batch: int, use_fsdp: bool = True,
+               epoch: int = 1, hosts: Optional[Sequence[int]] = None):
+    if world_size < 1:
+      raise ValueError('world_size must be >= 1; got {}.'.format(
+          world_size))
+    if local_device_count < 1:
+      raise ValueError('local_device_count must be >= 1; got {}.'.format(
+          local_device_count))
+    self.world_size = int(world_size)
+    self.local_device_count = int(local_device_count)
+    self.per_host_batch = int(per_host_batch)
+    self.epoch = int(epoch)
+    self.hosts = tuple(sorted(int(h) for h in hosts)) if hosts is not None \
+        else tuple(range(world_size))
+    if len(self.hosts) != self.world_size:
+      raise ValueError('hosts {} disagree with world_size {}.'.format(
+          self.hosts, world_size))
+    # fsdp stays ICI-local (fast links); it never spans DCN.
+    fsdp = 2 if (use_fsdp and self.local_device_count % 2 == 0
+                 and self.local_device_count >= 2) else 1
+    self.ici_axis_sizes = {'data': self.local_device_count // fsdp,
+                           'fsdp': fsdp}
+    self.dcn_axis_sizes = {'data': self.world_size}
+    self.use_fsdp = fsdp > 1
+
+  @property
+  def global_batch(self) -> int:
+    return self.per_host_batch * self.world_size
+
+  @property
+  def global_device_count(self) -> int:
+    return self.local_device_count * self.world_size
+
+  def rank(self, host: int) -> int:
+    return self.hosts.index(int(host))
+
+  def to_dict(self) -> Dict[str, object]:
+    return {
+        'epoch': self.epoch,
+        'world_size': self.world_size,
+        'hosts': list(self.hosts),
+        'local_device_count': self.local_device_count,
+        'ici_axis_sizes': dict(self.ici_axis_sizes),
+        'dcn_axis_sizes': dict(self.dcn_axis_sizes),
+        'per_host_batch': self.per_host_batch,
+        'global_batch': self.global_batch,
+    }
+
+  def __repr__(self):
+    return 'MeshPlan({})'.format(self.to_dict())
+
+
+def plan_mesh(world_size: int, local_device_count: int,
+              per_host_batch: int, use_fsdp: bool = True,
+              epoch: int = 1,
+              hosts: Optional[Sequence[int]] = None) -> MeshPlan:
+  """The one constructor call sites use (kwargs documented on MeshPlan)."""
+  return MeshPlan(world_size, local_device_count, per_host_batch,
+                  use_fsdp=use_fsdp, epoch=epoch, hosts=hosts)
+
+
+def build_mesh(plan: MeshPlan):
+  """A jax Mesh realizing ``plan`` for THIS process.
+
+  When the process genuinely spans the world (``jax.process_count() ==
+  plan.world_size > 1`` — a real pod), the DCN x ICI hybrid mesh is
+  built; otherwise (single-process — the CPU subprocess federation,
+  where each simulated host is its own jax world) the per-host local
+  data x fsdp mesh is built and the DCN 'data' axis lives in the plan's
+  shard assignment instead. Either way the LOCAL program is identical —
+  which is what lets the artifact store hand every world size the same
+  persisted executable.
+  """
+  import jax
+
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+  if plan.world_size > 1 and jax.process_count() == plan.world_size:
+    return mesh_lib.create_hybrid_mesh(
+        ici_axis_sizes=dict(plan.ici_axis_sizes),
+        dcn_axis_sizes=dict(plan.dcn_axis_sizes))
+  return mesh_lib.create_mesh(dict(plan.ici_axis_sizes))
+
+
+def shard_assignment(plan: MeshPlan, host: int) -> Tuple[int, int]:
+  """(shard_index, num_shards) for one host under one plan epoch.
+
+  Dense ranks over the sorted member list: after a shrink the surviving
+  hosts' ranks close over the gap, so between them they read EVERY input
+  shard again (no file orphaned with its departed reader).
+  """
+  return plan.rank(host), plan.world_size
+
+
+def reshard_plan(old_plan: MeshPlan, new_plan: MeshPlan
+                 ) -> Dict[str, object]:
+  """What actually changes when a checkpoint crosses world sizes.
+
+  The parameter tree is the invariant: Orbax stores GLOBAL arrays, and
+  the restore template carries the new mesh's shardings, so restoring
+  at the new world is a layout decision made at read time — no rewrite
+  of the checkpoint. Everything that DOES change is named here, and the
+  driver stamps the summary into its shrink/grow events so the
+  telemetry carries the resharding story.
+  """
+  return {
+      'params': 'global shapes unchanged; the restore template lays '
+                'each leaf onto the new mesh (Orbax resharding-on-read)',
+      'world_before': old_plan.world_size,
+      'world_after': new_plan.world_size,
+      'global_batch_before': old_plan.global_batch,
+      'global_batch_after': new_plan.global_batch,
+      'num_shards_before': old_plan.world_size,
+      'num_shards_after': new_plan.world_size,
+      'rank_moves': {
+          str(host): {'before': old_plan.rank(host),
+                      'after': new_plan.rank(host)}
+          for host in new_plan.hosts if host in old_plan.hosts
+          and old_plan.rank(host) != new_plan.rank(host)},
+  }

@@ -128,10 +128,14 @@ def diagnose(model_dir: str,
   # 'serving_stop'/'replay_stop'/'rl_stop'/'serving_fleet_stop' count
   # as orderly ends: a PolicyServer, ReplayService, RL loop or serving
   # fleet that closed cleanly stops heartbeating by design, which is
-  # not a wedged process.
-  run_ended = bool(records) and records[-1].get('kind') in (
-      'run_end', 'run_abort', 'preempted', 'serving_stop', 'replay_stop',
-      'rl_stop', 'serving_fleet_stop')
+  # not a wedged process. An elastic 'leave' event (ISSUE 15) is the
+  # same: the host departed orderly and stopped writing by design.
+  run_ended = bool(records) and (
+      records[-1].get('kind') in (
+          'run_end', 'run_abort', 'preempted', 'serving_stop',
+          'replay_stop', 'rl_stop', 'serving_fleet_stop')
+      or (records[-1].get('kind') == 'elastic'
+          and records[-1].get('event') == 'leave'))
   if run_ended and beat is not None:
     findings.append(_finding(
         INFO, 'run finished ({}); heartbeat age not meaningful'.format(
@@ -569,11 +573,11 @@ def diagnose(model_dir: str,
         hits=hits, misses=misses, compile_ms_total=compile_ms,
         workloads=workloads))
 
-  # Fleet section (ISSUE 9): federated per-host view. A host whose
-  # heartbeat is stale while others advance, or a straggler the fleet
-  # has not recovered from, halts/gates the whole mesh: CRITICAL while
-  # the run is live. Everything is recomputed from the per-host files —
-  # doctor must name the host without a live process anywhere.
+  # Fleet federation pass, computed BEFORE the elastic section: the
+  # elastic event ladder may live in ANOTHER host's stream (after a
+  # coordinator re-election the new coordinator narrates the shrink),
+  # so both the elastic verdicts and the fleet section judge the
+  # merged view.
   try:
     # Single-host dirs skip the federation pass: fleet_summary would
     # re-read every rotated generation this function already parsed,
@@ -587,6 +591,112 @@ def diagnose(model_dir: str,
     fsum = None
     findings.append(_finding(
         WARNING, 'fleet summary failed: {}'.format(e)))
+
+  # Elastic section (ISSUE 15): t2r.elastic.v1 membership events from
+  # the coordinator-led elastic driver. Two verdicts: a shrink that
+  # BEGAN but never completed its ladder (emergency_save ->
+  # mesh_rebuild -> artifact_rebind -> resume) has the fleet wedged
+  # mid-rebuild — CRITICAL while live, naming the stalled phase and the
+  # narrating host; otherwise an INFO summary of the world's history.
+  # The departed-host classification feeds the fleet section below: a
+  # host named departed by a shrink event must not page host_dead.
+  elastic_events = (fsum.get('elastic_events') if fsum is not None
+                    else None) or [r for r in records
+                                   if r.get('kind') == 'elastic']
+  orderly_departed: Dict[int, Dict[str, object]] = {}
+  lapse_departed: Dict[int, Dict[str, object]] = {}
+  if elastic_events:
+    from tensor2robot_tpu.elastic.membership import (
+        EVENT_GROW,
+        EVENT_JOIN,
+        EVENT_REBUILD,
+        EVENT_SHRINK,
+        EVENT_SHRINK_BEGIN,
+        EVENT_SHRINK_PHASE,
+        SHRINK_PHASES,
+    )
+
+    for event in elastic_events:
+      name = event.get('event')
+      if name in (EVENT_SHRINK_BEGIN, EVENT_SHRINK):
+        for host in event.get('departed') or []:
+          bucket = (orderly_departed if event.get('orderly')
+                    else lapse_departed)
+          bucket[int(host)] = event
+      elif name == EVENT_GROW:
+        for host in event.get('joined') or []:
+          orderly_departed.pop(int(host), None)
+          lapse_departed.pop(int(host), None)
+      elif name == EVENT_JOIN and event.get('host') is not None:
+        orderly_departed.pop(int(event['host']), None)
+        lapse_departed.pop(int(event['host']), None)
+    begins = [e for e in elastic_events
+              if e.get('event') == EVENT_SHRINK_BEGIN]
+    completed_epochs = {int(e.get('epoch') or 0) for e in elastic_events
+                       if e.get('event') == EVENT_SHRINK}
+    # A begin with no completion at its OWN epoch is only "wedged" while
+    # the world never moved past it: when the declaring coordinator
+    # itself dies mid-ladder, its shrink_begin is orphaned (only the
+    # coordinator narrates the ladder) and a SUCCESSOR completes the
+    # resize at a later epoch — any completed shrink or grow beyond the
+    # begin's epoch proves the fleet reconfigured past it.
+    resolved_epochs = completed_epochs | {
+        int(e.get('epoch') or 0) for e in elastic_events
+        if e.get('event') == EVENT_GROW}
+    stalled = [b for b in begins
+               if int(b.get('epoch') or 0) not in completed_epochs
+               and not any(epoch > int(b.get('epoch') or 0)
+                           for epoch in resolved_epochs)]
+    if stalled:
+      begin = stalled[-1]
+      epoch = int(begin.get('epoch') or 0)
+      done_phases = [e.get('phase') for e in elastic_events
+                     if e.get('event') == EVENT_SHRINK_PHASE
+                     and int(e.get('epoch') or 0) == epoch]
+      stalled_phase = next(
+          (phase for phase in SHRINK_PHASES if phase not in done_phases),
+          'resume')
+      reporter = begin.get('host', begin.get('process_index'))
+      findings.append(_finding(
+          WARNING if run_ended else CRITICAL,
+          'elastic shrink (epoch {}, world {} -> {}) stalled in the '
+          '{} phase: host {} declared host(s) {} departed but the '
+          'rebuild ladder never completed — the fleet is wedged '
+          'mid-resize'.format(
+              epoch, begin.get('world_before'), begin.get('world_after'),
+              stalled_phase, reporter, begin.get('departed')),
+          kind='elastic_rebuild_stalled', phase=stalled_phase,
+          host=reporter, epoch=epoch,
+          departed=begin.get('departed'),
+          completed_phases=done_phases))
+    else:
+      worlds = [int(e.get('world_after') or 0) for e in elastic_events
+                if e.get('event') in (EVENT_GROW, EVENT_SHRINK_BEGIN)]
+      shrinks = [e for e in elastic_events
+                 if e.get('event') == EVENT_SHRINK]
+      grows = [e for e in elastic_events if e.get('event') == EVENT_GROW]
+      rebuilds = [e for e in elastic_events
+                  if e.get('event') == EVENT_REBUILD
+                  and int(e.get('epoch') or 0) > 1]
+      rebuild_compiles = sum(float(e.get('compiles_delta') or 0.0)
+                             for e in rebuilds)
+      findings.append(_finding(
+          INFO, 'elastic: world size {} after {} shrink(s) / {} grow(s)'
+          ' ({} orderly departure(s)); {} post-epoch-1 rebuild(s) cost '
+          '{:g} XLA compile(s)'.format(
+              worlds[-1] if worlds else 'n/a', len(shrinks), len(grows),
+              sum(1 for e in shrinks if e.get('orderly')),
+              len(rebuilds), rebuild_compiles),
+          kind='elastic_summary',
+          world_size=worlds[-1] if worlds else None,
+          shrinks=len(shrinks), grows=len(grows),
+          rebuild_compiles=rebuild_compiles))
+
+  # Fleet section (ISSUE 9): federated per-host view. A host whose
+  # heartbeat is stale while others advance, or a straggler the fleet
+  # has not recovered from, halts/gates the whole mesh: CRITICAL while
+  # the run is live. Everything is recomputed from the per-host files —
+  # doctor must name the host without a live process anywhere.
   fleet_records = [r for r in records if r.get('kind') == 'fleet']
   if fsum is not None and (fsum['host_count'] > 1 or fsum['recoveries']):
     if fsum['host_count'] > 1:
@@ -604,6 +714,36 @@ def diagnose(model_dir: str,
           fleet_min_goodput=fsum.get('fleet_min_goodput')))
     for host in fsum['dead_hosts']:
       entry = fsum['hosts'].get(str(host), {})
+      if int(host) in orderly_departed:
+        # ISSUE 15: the host departed in an ORDERLY elastic shrink — a
+        # t2r.elastic.v1 shrink event names it, the fleet reconfigured
+        # around it on purpose, and its silence is the design, not a
+        # death. INFO, citing the shrink event.
+        event = orderly_departed[int(host)]
+        findings.append(_finding(
+            INFO, 'fleet: host {} departed in an orderly elastic '
+            'shrink (epoch {}, world {} -> {}); its stale heartbeat is '
+            'expected, not a page'.format(
+                host, event.get('epoch'), event.get('world_before'),
+                event.get('world_after')),
+            kind='host_departed_orderly', host=host,
+            epoch=event.get('epoch')))
+        continue
+      if int(host) in lapse_departed:
+        # Preempted, but the elastic shrink already reconfigured the
+        # fleet around it: the outage is history (the recovery record
+        # carries it), not a live page — unless it never resumed, which
+        # the stuck-rebuild CRITICAL above owns.
+        event = lapse_departed[int(host)]
+        findings.append(_finding(
+            WARNING, 'fleet: host {} was preempted and the elastic '
+            'shrink (epoch {}, world {} -> {}) already closed around '
+            'it — evidence, not a live page'.format(
+                host, event.get('epoch'), event.get('world_before'),
+                event.get('world_after')),
+            kind='host_departed_preempted', host=host,
+            epoch=event.get('epoch')))
+        continue
       # WARNING (not INFO) after run end — same downgrade rule as the
       # straggler verdict: a host that died during a now-ended run is
       # still evidence worth surfacing, just not a live page.
@@ -652,19 +792,26 @@ def diagnose(model_dir: str,
   recoveries = (fsum['recoveries'] if fsum is not None else
                 [r for r in records if r.get('kind') == 'recovery'])
   for recovery in recoveries:
+    worlds = ''
+    if recovery.get('world_before') is not None:
+      worlds = ', world {} -> {}'.format(recovery.get('world_before'),
+                                         recovery.get('world_after'))
     findings.append(_finding(
         INFO, 'recovered from preemption at step {} in {:.1f}s '
         '(save {:.1f}s, down {:.1f}s, restore {:.1f}s, first step '
-        '{:.1f}s)'.format(
+        '{:.1f}s{})'.format(
             recovery.get('preempted_step'),
             recovery.get('preemption_recovery_seconds') or 0.0,
             (recovery.get('phases') or {}).get('emergency_save_s', 0.0),
             (recovery.get('phases') or {}).get('downtime_s', 0.0),
             (recovery.get('phases') or {}).get('restore_s', 0.0),
-            (recovery.get('phases') or {}).get('first_step_s', 0.0)),
+            (recovery.get('phases') or {}).get('first_step_s', 0.0),
+            worlds),
         kind='recovery',
         preemption_recovery_seconds=recovery.get(
-            'preemption_recovery_seconds')))
+            'preemption_recovery_seconds'),
+        world_before=recovery.get('world_before'),
+        world_after=recovery.get('world_after')))
 
   # Watchdog anomaly records written in-process.
   anomalies = [r for r in records if r.get('kind') == 'anomaly']

@@ -528,6 +528,11 @@ def fleet_summary(model_dir: str, now: Optional[float] = None,
       kind = str(record.get('anomaly'))
       anomaly_counts[kind] = anomaly_counts.get(kind, 0) + 1
   recoveries = [r for r in merged if r.get('kind') == 'recovery']
+  # Elastic membership events (t2r.elastic.v1, ISSUE 15): the merged
+  # cross-host view, so doctor's shrink-aware verdicts (host_dead
+  # downgrade, stuck-rebuild) see the coordinator's ladder whichever
+  # host is coordinating after a re-election.
+  elastic_events = [r for r in merged if r.get('kind') == 'elastic']
   return {
       'host_count': len(fleet['hosts']),
       'hosts': hosts,
@@ -547,7 +552,10 @@ def fleet_summary(model_dir: str, now: Optional[float] = None,
               r.get('preemption_recovery_seconds'),
           'phases': r.get('phases'),
           'process_index': r.get('process_index', 0),
+          'world_before': r.get('world_before'),
+          'world_after': r.get('world_after'),
       } for r in recoveries],
+      'elastic_events': elastic_events,
       'warnings': fleet['warnings'],
   }
 
@@ -563,7 +571,8 @@ def recovery_marker_path(model_dir: str,
 
 def write_recovery_marker(model_dir: str, step: int, signum: int,
                           save_seconds: float,
-                          process_index: Optional[int] = None) -> str:
+                          process_index: Optional[int] = None,
+                          **extra) -> str:
   """Atomically records "a preemption just happened here".
 
   Written by the PREEMPTING process after its emergency save commits;
@@ -571,6 +580,10 @@ def write_recovery_marker(model_dir: str, step: int, signum: int,
   different host booting the same model_dir), which is why the stamp is
   wall-clock. ``save_seconds`` is the emergency save's duration — the
   first phase of the recovery timeline, measurable only on this side.
+  ``extra`` fields ride the marker into the recovery record — the
+  elastic coordinator stamps ``world_before``/``world_after``/
+  ``departed`` here so a shrink's ``t2r.recovery.v1`` carries the world
+  change (``build_recovery_record`` forwards them).
   """
   path = recovery_marker_path(model_dir, process_index)
   marker = {
@@ -580,6 +593,7 @@ def write_recovery_marker(model_dir: str, step: int, signum: int,
       'save_seconds': float(save_seconds),
       'process_index': int(process_index or 0),
   }
+  marker.update(extra)
   tmp = path + '.tmp'
   with open(tmp, 'w', encoding='utf-8') as f:
     json.dump(marker, f)
@@ -644,7 +658,7 @@ def build_recovery_record(marker: Dict[str, object],
   span = max(since_marker, measured)
   total = save_s + span
   downtime = span - measured
-  return {
+  record = {
       'schema': RECOVERY_SCHEMA,
       'preempted_step': marker.get('step'),
       'resume_step': int(resume_step),
@@ -657,3 +671,11 @@ def build_recovery_record(marker: Dict[str, object],
       },
       'preemption_recovery_seconds': total,
   }
+  # Elastic markers (ISSUE 15) stamp the world change at declaration
+  # time; forwarding them here is what makes the recovery record carry
+  # world_before/world_after without the resuming trainer knowing
+  # anything about membership.
+  for key in ('world_before', 'world_after', 'departed', 'elastic'):
+    if key in marker:
+      record[key] = marker[key]
+  return record

@@ -18,6 +18,17 @@ Used by ``tests/test_fleet.py`` (subprocess federation round-trip),
 ``bin/check_fleet_doctor`` (jax-free doctor fixtures), and the
 MULTICHIP dryrun's fleet phase (the simulated peer host). Jax-free by
 construction.
+
+**Membership churn (ISSUE 15)**: ``write_member_run`` is the elastic
+variant — the same telemetry windows plus a LEASE renewed per window
+and ``t2r.elastic.v1`` join/leave events, ending in an orderly leave,
+a lease LAPSE (the writer just stops renewing — the preemption
+signature), or live. ``write_shrink_events`` writes a coordinator's
+shrink ladder (``shrink_begin -> shrink_phase* -> shrink`` + optional
+recovery record). Together they let the elastic federation + doctor
+logic (orderly-departure downgrade, stuck-rebuild paging) test with
+real processes and zero jax — ``bin/check_elastic_doctor`` and
+tests/test_elastic.py both build their fixtures from these writers.
 """
 
 from __future__ import annotations
@@ -28,7 +39,8 @@ from typing import Dict, Optional, Sequence
 
 from tensor2robot_tpu.observability.telemetry_file import TelemetryLogger
 
-__all__ = ['host_meta', 'write_host_run', 'main']
+__all__ = ['host_meta', 'write_host_run', 'write_member_run',
+           'write_shrink_events', 'main']
 
 
 def host_meta(process_index: int, process_count: int,
@@ -70,30 +82,183 @@ def write_host_run(model_dir: str,
   logger = TelemetryLogger(model_dir, host_meta=meta)
   logger.log('run_start', step=0, batch_size=batch_size,
              max_train_steps=steps_per_window * len(step_times_s))
-  step = 0
-  for window, step_time_s in enumerate(step_times_s):
-    step = steps_per_window * (window + 1)
-    examples_per_sec = batch_size / max(step_time_s, 1e-9)
-    goodput = {'productive': productive, 'data': 1.0 - productive,
-               'checkpoint': 0.0, 'retry': 0.0}
-    logger.log('train', step=step, loss=0.5, step_time_s=step_time_s,
-               examples_per_sec=examples_per_sec, goodput=goodput,
-               gauges={}, counters={})
-    extra = {'step_time_s': step_time_s,
-             'examples_per_sec': examples_per_sec,
-             'productive_fraction': productive}
-    if heartbeat_time is not None and window == len(step_times_s) - 1:
-      extra['time'] = heartbeat_time
-    logger.heartbeat(step, **extra)
-    logger.flush()
-    if sleep_per_window_s > 0.0:
-      time.sleep(sleep_per_window_s)
+  step = _write_windows(logger, step_times_s, steps_per_window,
+                        batch_size, productive, heartbeat_time,
+                        sleep_per_window_s)
   if end != 'live':
     logger.log(end, step=step, goodput={
         'productive': productive, 'data': 1.0 - productive,
         'checkpoint': 0.0, 'retry': 0.0})
   logger.close()
   return logger
+
+
+def _write_windows(logger: TelemetryLogger,
+                   step_times_s: Sequence[float],
+                   steps_per_window: int,
+                   batch_size: int,
+                   productive: float,
+                   heartbeat_time: Optional[float],
+                   sleep_per_window_s: float,
+                   per_window=None) -> int:
+  """The per-window emission both simulated writers share.
+
+  One ``train`` record + heartbeat per entry of ``step_times_s``, at
+  steps ``steps_per_window, 2x, ...``; ``per_window(window, last)``
+  runs between the heartbeat and the flush (the elastic member renews
+  its lease there). Returns the final step.
+  """
+  step = 0
+  for window, step_time_s in enumerate(step_times_s):
+    step = steps_per_window * (window + 1)
+    examples_per_sec = batch_size / max(step_time_s, 1e-9)
+    logger.log('train', step=step, loss=0.5, step_time_s=step_time_s,
+               examples_per_sec=examples_per_sec,
+               goodput={'productive': productive,
+                        'data': 1.0 - productive,
+                        'checkpoint': 0.0, 'retry': 0.0},
+               gauges={}, counters={})
+    extra = {'step_time_s': step_time_s,
+             'examples_per_sec': examples_per_sec,
+             'productive_fraction': productive}
+    last = window == len(step_times_s) - 1
+    if heartbeat_time is not None and last:
+      extra['time'] = heartbeat_time
+    logger.heartbeat(step, **extra)
+    if per_window is not None:
+      per_window(window, last)
+    logger.flush()
+    if sleep_per_window_s > 0.0:
+      time.sleep(sleep_per_window_s)
+  return step
+
+
+def write_member_run(model_dir: str,
+                     process_index: int,
+                     process_count: int,
+                     step_times_s: Sequence[float],
+                     steps_per_window: int = 100,
+                     batch_size: int = 32,
+                     productive: float = 0.9,
+                     membership_end: str = 'leave',
+                     sleep_per_window_s: float = 0.0,
+                     heartbeat_time: Optional[float] = None,
+                     lease_backdate_s: float = 3600.0,
+                     device_kind: str = 'sim-cpu') -> TelemetryLogger:
+  """One simulated ELASTIC member: telemetry windows + lease churn.
+
+  Emits what an elastic host emits: a ``t2r.elastic.v1`` join event, a
+  lease renewed once per window, the usual per-window ``train`` records
+  + heartbeats, and one of three endings —
+
+    * ``'leave'``  — orderly: ``run_end``, the lease flips to
+      ``status='leaving'``, and a ``leave`` event lands (the departure
+      the doctor must NOT page for once a shrink event names it);
+    * ``'lapse'``  — preemption signature: NO terminal record, and the
+      final lease stamp is BACKDATED ``lease_backdate_s`` so observers
+      see it already lapsed (a subprocess writer need not outwait a
+      TTL);
+    * ``'live'``   — fresh lease, no terminal record: mid-run.
+  """
+  from tensor2robot_tpu.elastic import membership as membership_lib
+
+  if membership_end not in ('leave', 'lapse', 'live'):
+    raise ValueError('unknown membership_end {!r}'.format(membership_end))
+  meta = host_meta(process_index, process_count, device_kind=device_kind)
+  logger = TelemetryLogger(model_dir, host_meta=meta)
+  previous = membership_lib.read_leases(model_dir).get(int(process_index))
+  incarnation = int((previous or {}).get('incarnation', 0)) + 1
+  membership_lib.write_lease(model_dir, process_index,
+                             incarnation=incarnation)
+  logger.log('elastic', step=0, **membership_lib.elastic_record(
+      membership_lib.EVENT_JOIN, host=int(process_index),
+      incarnation=incarnation, target_world=int(process_count)))
+  def renew_lease(window, last):
+    if last and membership_end == 'lapse':
+      # The preemption signature: an ACTIVE lease that is already
+      # stale — the writer died without saying anything.
+      membership_lib.write_lease(
+          model_dir, process_index, incarnation=incarnation,
+          now=time.time() - lease_backdate_s)  # wall-clock: backdated stamp
+    else:
+      membership_lib.write_lease(model_dir, process_index,
+                                 incarnation=incarnation)
+
+  step = _write_windows(logger, step_times_s, steps_per_window,
+                        batch_size, productive, heartbeat_time,
+                        sleep_per_window_s, per_window=renew_lease)
+  if membership_end == 'leave':
+    logger.log('run_end', step=step, goodput={
+        'productive': productive, 'data': 1.0 - productive,
+        'checkpoint': 0.0, 'retry': 0.0})
+    membership_lib.release_lease(model_dir, process_index,
+                                 incarnation=incarnation)
+    logger.log('elastic', step=step, **membership_lib.elastic_record(
+        membership_lib.EVENT_LEAVE, host=int(process_index),
+        incarnation=incarnation))
+  logger.close()
+  return logger
+
+
+def write_shrink_events(model_dir: str,
+                        coordinator: int,
+                        epoch: int,
+                        world_before: int,
+                        world_after: int,
+                        departed: Sequence[int],
+                        orderly: bool = True,
+                        phases: Optional[Sequence[str]] = None,
+                        complete: bool = True,
+                        recovery: bool = False,
+                        step: int = 0,
+                        process_count: Optional[int] = None
+                        ) -> None:
+  """One coordinator's shrink ladder, as fixture telemetry.
+
+  ``phases`` truncates the ladder (``None`` = all of SHRINK_PHASES):
+  a fixture with only ``('emergency_save',)`` and ``complete=False`` is
+  the STUCK rebuild doctor pages on, naming ``mesh_rebuild`` as the
+  stalled phase. ``recovery=True`` appends the ``t2r.recovery.v1``
+  record a real (non-orderly) shrink closes with, phases summing to the
+  total and carrying the world change.
+  """
+  from tensor2robot_tpu.elastic import membership as membership_lib
+
+  if phases is None:
+    phases = membership_lib.SHRINK_PHASES
+  meta = host_meta(coordinator, process_count or world_before)
+  logger = TelemetryLogger(model_dir, host_meta=meta)
+  base = dict(epoch=int(epoch), world_before=int(world_before),
+              world_after=int(world_after),
+              departed=[int(h) for h in departed], orderly=bool(orderly))
+  logger.log('elastic', step=step, **membership_lib.elastic_record(
+      membership_lib.EVENT_SHRINK_BEGIN, host=int(coordinator), **base))
+  for phase in phases:
+    payload = {'phase': phase, 'seconds': 0.1}
+    if phase == 'artifact_rebind':
+      payload.update(artifact_outcome='hit', compiles_delta=0.0)
+    logger.log('elastic', step=step, **membership_lib.elastic_record(
+        membership_lib.EVENT_SHRINK_PHASE, host=int(coordinator),
+        epoch=int(epoch), **payload))
+  if complete:
+    logger.log('elastic', step=step + 1, **membership_lib.elastic_record(
+        membership_lib.EVENT_REBUILD, host=int(coordinator),
+        epoch=int(epoch), world_size=int(world_after),
+        artifact_outcome='hit', compiles_delta=0.0))
+    logger.log('elastic', step=step + 1, **membership_lib.elastic_record(
+        membership_lib.EVENT_SHRINK, host=int(coordinator), **base))
+  if recovery:
+    logger.log('recovery', step=step + 1,
+               schema='t2r.recovery.v1', preempted_step=step,
+               resume_step=step + 1,
+               signum=membership_lib.ELASTIC_LAPSE_SIGNUM,
+               phases={'emergency_save_s': 0.2, 'downtime_s': 1.0,
+                       'restore_s': 0.5, 'first_step_s': 0.3},
+               preemption_recovery_seconds=2.0,
+               world_before=int(world_before),
+               world_after=int(world_after),
+               departed=[int(h) for h in departed], elastic=True)
+  logger.close()
 
 
 def main(argv=None):
@@ -107,11 +272,25 @@ def main(argv=None):
   parser.add_argument('--end', default='run_end',
                       choices=('run_end', 'preempted', 'live'))
   parser.add_argument('--sleep_per_window_secs', type=float, default=0.0)
+  parser.add_argument('--member', action='store_true',
+                      help='elastic-member mode: renew a lease per '
+                      'window and emit t2r.elastic.v1 join/leave events')
+  parser.add_argument('--membership_end', default='leave',
+                      choices=('leave', 'lapse', 'live'),
+                      help='--member ending: orderly leave, lease '
+                      'lapse (preemption signature), or live')
   args = parser.parse_args(argv)
+  step_times = [float(t) for t in args.step_times.split(',') if t]
+  if args.member:
+    write_member_run(
+        args.model_dir, args.process_index, args.process_count,
+        step_times, steps_per_window=args.steps_per_window,
+        membership_end=args.membership_end,
+        sleep_per_window_s=args.sleep_per_window_secs)
+    return
   write_host_run(
       args.model_dir, args.process_index, args.process_count,
-      [float(t) for t in args.step_times.split(',') if t],
-      steps_per_window=args.steps_per_window, end=args.end,
+      step_times, steps_per_window=args.steps_per_window, end=args.end,
       sleep_per_window_s=args.sleep_per_window_secs)
 
 

@@ -52,13 +52,19 @@ def multihost_dryrun(workdir: str, num_processes: int, process_id: int,
   shards assemble into one global batch (each host reads DIFFERENT files),
   (c) the jitted step runs with gradients psummed across hosts, (d) the
   Orbax checkpoint written cooperatively restores to identical params on
-  every host, and (e — ISSUE 9) each host emitted its OWN
+  every host, (e — ISSUE 9) each host emitted its OWN
   ``telemetry.<process_index>.jsonl`` under the SHARED model_dir (two
   processes appending one file would interleave torn lines), stamped
   with its identity, and host 0's fleet view federates every host's
-  stream.
+  stream, and (f — ISSUE 15) the train step resolves through the shared
+  ``CompiledArtifact`` store: host 0 AOT-compiles and PERSISTS the
+  executable behind a barrier, hosts 1..N then bind by DESERIALIZING it
+  — their ``jax/compiles`` delta across the bind is asserted 0, closing
+  ROADMAP item 4's shared-autotuner/compile-cache clause (N hosts, one
+  compile).
   """
   import jax
+  import jax.numpy as jnp
   import numpy as np
   from jax.experimental import multihost_utils
 
@@ -67,6 +73,7 @@ def multihost_dryrun(workdir: str, num_processes: int, process_id: int,
   from tensor2robot_tpu.data.input_generators import (
       DefaultRecordInputGenerator,
   )
+  from tensor2robot_tpu.observability import get_registry
   from tensor2robot_tpu.trainer import Trainer
   from tensor2robot_tpu.utils.mocks import MockT2RModel
 
@@ -104,7 +111,40 @@ def multihost_dryrun(workdir: str, num_processes: int, process_id: int,
   model_dir = os.path.join(workdir, 'model')
   trainer = Trainer(model, model_dir, mesh=mesh, async_checkpoints=False,
                     save_checkpoints_steps=train_steps,
-                    log_every_n_steps=10**9)
+                    log_every_n_steps=10**9,
+                    use_compiled_artifacts=True,
+                    artifact_workload='multihost_step',
+                    tuning_cache_path=os.path.join(workdir,
+                                                   'compile_cache.json'))
+  # ISSUE 15 satellite: the train step resolves through the SHARED
+  # CompiledArtifact store. Host 0 AOT-compiles and persists the
+  # executable while everyone else waits at the barrier; hosts 1..N
+  # then bind by DESERIALIZING it — with their jax/compiles delta
+  # across the bind asserted 0 (N hosts, ONE compile: ROADMAP item 4's
+  # shared-autotuner/compile-cache clause). bind_train_step never
+  # executes the (collective) step, which is what makes the stagger
+  # legal before the first synchronized train step below.
+  generator.set_specification_from_model(model, 'train')
+  bind_features, bind_labels = next(generator.create_dataset_iterator(
+      mode='train', shard_index=process_id, num_shards=num_processes))
+  registry = get_registry()
+  if process_id == 0:
+    artifact = trainer.bind_train_step(bind_features, bind_labels)
+    assert artifact is not None and not artifact.from_cache, (
+        'host 0 must compile + persist the shared executable', artifact)
+  multihost_utils.sync_global_devices('artifact_persisted')
+  if process_id != 0:
+    compiles_before = float(registry.scalars().get('jax/compiles', 0.0))
+    artifact = trainer.bind_train_step(bind_features, bind_labels)
+    compiles_delta = float(
+        registry.scalars().get('jax/compiles', 0.0)) - compiles_before
+    assert artifact is not None and artifact.from_cache, (
+        'follower must deserialize host 0\'s persisted executable',
+        artifact)
+    assert compiles_delta == 0.0, (
+        'follower bind must not compile: jax/compiles delta %r'
+        % compiles_delta)
+  multihost_utils.sync_global_devices('artifact_bound')
   # Per-host file shards come from the process-aware train() defaults.
   state = trainer.train(generator, max_train_steps=train_steps)
   assert int(jax.device_get(state.step)) == train_steps

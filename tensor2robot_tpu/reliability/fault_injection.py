@@ -41,6 +41,11 @@ Sites wired in this codebase (docs/reliability.md):
     actor-side weight-swap poll (the snapshot is not adopted); the
     next poll retries, so the loop converges anyway — the protocol's
     at-least-once claim, driven deterministically
+  * ``elastic.rebuild`` elastic mesh rebuild (elastic/driver.py) →
+    host-side sleep wedging the shrink/grow rebuild
+    (``ELASTIC_REBUILD_STALL_SECONDS``), the symptom the doctor's
+    stuck-rebuild rule must catch and attribute to the stalled shrink
+    phase (docs/elastic.md)
 
 The injector is config-registrable: bind ``configure_fault_injector`` in a
 gin file to arm faults for a whole run without touching code.
@@ -64,11 +69,12 @@ SITE_REPLAY_APPEND = 'replay.append'
 SITE_REPLAY_SAMPLE = 'replay.sample'
 SITE_ACTOR_STALL = 'actor.stall'
 SITE_LEARNER_SWAP = 'learner.swap'
+SITE_ELASTIC_REBUILD = 'elastic.rebuild'
 
 KNOWN_SITES = (SITE_CKPT_SAVE, SITE_CKPT_RESTORE, SITE_DATA_READ,
                SITE_STEP_NAN, SITE_STEP_SLOW, SITE_DATA_STALL,
                SITE_HOST_PREEMPT, SITE_REPLAY_APPEND, SITE_REPLAY_SAMPLE,
-               SITE_ACTOR_STALL, SITE_LEARNER_SWAP)
+               SITE_ACTOR_STALL, SITE_LEARNER_SWAP, SITE_ELASTIC_REBUILD)
 
 # Signum stamped into preemption records driven by the injected
 # 'host.preempt' site (no real signal was delivered).
@@ -87,6 +93,9 @@ REPLAY_SAMPLE_STALL_SECONDS = 0.25
 
 # How long one fired 'actor.stall' wedges the RL loop's acting step.
 ACTOR_STALL_SECONDS = 0.25
+
+# How long one fired 'elastic.rebuild' wedges an elastic mesh rebuild.
+ELASTIC_REBUILD_STALL_SECONDS = 0.25
 
 
 class FaultInjector:
@@ -201,6 +210,14 @@ def actor_stall_seconds() -> float:
   injector = _INJECTOR
   if injector is not None and injector.fires(SITE_ACTOR_STALL):
     return ACTOR_STALL_SECONDS
+  return 0.0
+
+
+def elastic_rebuild_stall_seconds() -> float:
+  """Seconds the 'elastic.rebuild' site wedges THIS rebuild; 0.0 unarmed."""
+  injector = _INJECTOR
+  if injector is not None and injector.fires(SITE_ELASTIC_REBUILD):
+    return ELASTIC_REBUILD_STALL_SECONDS
   return 0.0
 
 

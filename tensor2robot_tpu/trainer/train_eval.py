@@ -133,7 +133,9 @@ class Trainer:
                tuning_cache_path: Optional[str] = None,
                use_compiled_artifacts: bool = False,
                artifact_workload: Optional[str] = None,
-               feed_depth: int = 1):
+               feed_depth: int = 1,
+               host_identity: Optional[Dict[str, object]] = None,
+               shared_telemetry: Optional[TelemetryLogger] = None):
     """write_metrics: emit TensorBoard events (train scalars under
     model_dir, eval under model_dir/eval[_<eval_name>] — the reference's
     per-eval-run dirs, ref utils/train_eval.py:539-547).
@@ -219,6 +221,18 @@ class Trainer:
     keeps timing each copy to completion in the producer thread, so
     MB/s attribution is unchanged. 1 (default) keeps the synchronous
     hop.
+    host_identity: overrides the fleet identity stamp
+    (``signals.host_identity()``) for this trainer's telemetry,
+    heartbeat, recovery-marker and forensics records. The elastic
+    driver (tensor2robot_tpu/elastic) uses it because each simulated
+    host of the CPU federation is its own jax world —
+    ``jax.process_index()`` is 0 everywhere — while the ELASTIC host
+    index must route each process to its own ``telemetry.<i>.jsonl``.
+    shared_telemetry: use this TelemetryLogger instead of constructing
+    one, and do NOT close it in ``close()`` — the elastic driver keeps
+    ONE per-host stream alive across the per-epoch trainers it builds
+    (two loggers appending one file from one process would interleave
+    buffered writes mid-line).
     """
     self.model = model
     self.model_dir = model_dir
@@ -265,7 +279,9 @@ class Trainer:
     self._enable_fleet = enable_fleet
     self._fleet_config = fleet_config
     self._fleet_observer: Optional[fleet_lib.FleetObserver] = None
-    self._host_identity: Optional[Dict[str, object]] = None
+    self._host_identity: Optional[Dict[str, object]] = (
+        dict(host_identity) if host_identity else None)
+    self._shared_telemetry = shared_telemetry
     # Compile-event accounting (jax/compiles, jax/compile_ms) feeds the
     # watchdog's recompile detection; idempotent per process.
     signals_lib.install_jax_listeners()
@@ -348,6 +364,8 @@ class Trainer:
     (``telemetry.<process_index>.jsonl``) via the identity host_meta —
     N processes sharing one model_dir must never append to one file.
     """
+    if self._shared_telemetry is not None:
+      return self._shared_telemetry
     if self._write_metrics and self._telemetry is None:
       self._telemetry = TelemetryLogger(self.model_dir,
                                         host_meta=self.host_identity)
@@ -534,12 +552,24 @@ class Trainer:
 
     batch = self._batch_sharding()
     replicated = NamedSharding(self.mesh, P())
+    # The artifact path compiles WITHOUT donation: a persisted
+    # (serialize_executable) train step with input/output aliasing baked
+    # in executes incorrectly after deserialization on this jaxlib's CPU
+    # backend — an Orbax-restored state donated into a deserialized
+    # executable comes back with a skewed step counter / rng fold
+    # (pinned by tests/test_elastic.py's cross-process repro; the same
+    # program self-compiled, or run on fresh-init state, is fine). The
+    # cost is one transient state copy per step; the stock jit path
+    # keeps the donation.
+    jit_kwargs = {}
+    if not self._use_compiled_artifacts:
+      jit_kwargs['donate_argnums'] = (0,)
     jitted = jax.jit(
         step,
         in_shardings=(self._state_sharding, batch, batch, replicated,
                       replicated),
         out_shardings=(self._state_sharding, replicated),
-        donate_argnums=(0,))
+        **jit_kwargs)
 
     def call(state, features, labels, base_rng, force_nan=None):
       # force_nan defaults off so external callers of the compiled step
@@ -563,6 +593,50 @@ class Trainer:
     self._train_step_jitted = jitted
     self._train_step_fn = call
     return self._train_step_fn
+
+  def bind_train_step(self, features: SpecStruct,
+                      labels: Optional[SpecStruct]):
+    """AOT-binds the train-step executable WITHOUT executing a step.
+
+    The cold-start prewarm hook: resolves the step through the
+    ``CompiledArtifact`` store (or the legacy tuned hook) from a sample
+    host batch alone — tracing, lowering, and (on a store hit)
+    deserializing, but never running the program. That split is what
+    lets a multi-host bring-up stagger "host 0 compiles + persists,
+    hosts 1..N deserialize" around a barrier even though the step
+    itself is a collective no host can run alone
+    (``parallel/multihost.py``), and it is how an elastic rebuild can
+    bind before its first probe step. Returns the bound
+    ``CompiledArtifact`` (None when binding fell back to the stock jit
+    path). Idempotent: a later ``train()`` reuses the binding.
+    """
+    rng = jax.random.PRNGKey(self.seed)
+    pre_features, pre_labels = self.model.preprocessor.preprocess(
+        features, labels, ModeKeys.TRAIN,
+        rng=jax.random.PRNGKey(self.seed + 2))
+    abstract_state = jax.eval_shape(
+        lambda: self.model.create_train_state(rng, pre_features,
+                                              pre_labels))
+    self._state_sharding = sharding_lib.train_state_sharding(
+        abstract_state, self.mesh, use_fsdp=self.use_fsdp,
+        tp_rules=self.tp_rules)
+    self._compile_train_step()
+    if self._step_abstract is None:
+      # The batch crosses the real device feed so the abstract batch
+      # carries GLOBAL shapes (a multi-process host's local slice is
+      # only 1/Nth of what the step consumes).
+      device_batch = self._put_batch(
+          {'features': features.to_dict(),
+           'labels': labels.to_dict() if labels is not None else None})
+      base_rng = jax.random.PRNGKey(self.seed + 1)
+      self._step_abstract = jax.tree.map(
+          lambda leaf: jax.ShapeDtypeStruct(jnp.shape(leaf),
+                                            jnp.result_type(leaf)),
+          (abstract_state, device_batch['features'],
+           device_batch['labels'], base_rng, np.asarray(False)))
+      self._bind_compiled_step(self._train_step_jitted,
+                               self._step_abstract)
+    return self._train_step_artifact
 
   def _resolve_tuned_config(self, args):
     """tuned_config (CompileConfig | dict | workload-name str) ->
@@ -1219,7 +1293,8 @@ class Trainer:
         self.checkpoint_manager.wait_until_finished()
       except Exception as e:  # noqa: BLE001
         _log('Emergency checkpoint failed: %s', e)
-    for writer in (self._train_writer, self._eval_writer, self._telemetry):
+    for writer in (self._train_writer, self._eval_writer, self._telemetry,
+                   self._shared_telemetry):
       if writer is not None:
         try:
           writer.flush()
@@ -1389,6 +1464,10 @@ class Trainer:
     for writer in (self._train_writer, self._eval_writer, self._telemetry):
       if writer is not None:
         writer.close()
+    if self._shared_telemetry is not None:
+      # Shared stream: flush but never close — its owner (the elastic
+      # driver) outlives this per-epoch trainer.
+      self._shared_telemetry.flush()
     self._train_writer = self._eval_writer = self._telemetry = None
 
 

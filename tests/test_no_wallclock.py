@@ -51,8 +51,14 @@ import os
 # an NTP step must not eject a healthy replica or fake a scale-up
 # latency); fleet telemetry timestamps go through TelemetryLogger
 # (already annotated).
+# 'elastic' joined with ISSUE 15: lease-renewal pacing, boundary-segment
+# deadlines and the shrink-ladder phase timings are durations (a
+# wall-clock jump must not lapse a healthy host's lease); only the
+# lease/plan STAMPS that cross process boundaries are wall-clock, and
+# they carry the annotation.
 SCANNED_PACKAGES = ('trainer', 'reliability', 'observability', 'data',
-                    'serving', 'replay', 'envs', 'rl', 'compile')
+                    'serving', 'replay', 'envs', 'rl', 'compile',
+                    'elastic')
 MARKER = 'wall-clock'
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
