@@ -696,17 +696,28 @@ def _bench_qtopt(mesh, on_tpu: bool, tuned=None):
       trainer, state, step_fn, rng, batch = _trainer_step_setup(
           model, mesh, batch_size, tmp, tuned_config=tuned)
       try:
-        flops_per_step = 0.0
-        try:
-          cost = step_fn.lower(state, batch['features'], batch['labels'],
-                               rng).compile().cost_analysis()
-          if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-          flops_per_step = float(cost.get('flops', 0.0))
-        except Exception:  # noqa: BLE001 — cost analysis is best-effort
-          pass
         state, _ = step_fn(state, batch['features'], batch['labels'], rng)
         _sync(state)
+        # ONE cost model for the whole stack (ISSUE 19): the same
+        # trainer._step_cost() -> hlo_analysis.program_cost resolution the
+        # live perf/mfu gauges and the forensics roofline record use —
+        # bench and live training can no longer disagree about what a
+        # step costs. Runs after the warmup step because the trainer
+        # records its abstract step signature on first call.
+        step_cost = {'flops': 0.0, 'bytes': 0.0, 'source': 'unavailable'}
+        try:
+          from tensor2robot_tpu.observability import roofline
+          from tensor2robot_tpu.parallel import hlo_analysis
+          cost = trainer._step_cost()
+          if cost:
+            step_cost = dict(cost)
+            hlo = trainer._train_step_hlo()
+            if hlo:
+              step_cost['gating_family'] = roofline.static_gating_family(
+                  hlo_analysis.op_cost_table(hlo),
+                  getattr(jax.devices()[0], 'device_kind', 'unknown'))
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+          pass
         t0 = time.time()
         for _ in range(n_steps):
           state, _ = step_fn(state, batch['features'], batch['labels'],
@@ -724,7 +735,7 @@ def _bench_qtopt(mesh, on_tpu: bool, tuned=None):
         dt_synced = time.time() - t0
       finally:
         trainer.close()
-    return batch_size, dt, flops_per_step, n_steps, dt_synced
+    return batch_size, dt, step_cost, n_steps, dt_synced
 
   return model, _try_batches(candidate_batches, _attempt)
 
@@ -921,16 +932,20 @@ def _grasp2vec_attempt(model, mesh, batch_size, n_steps):
         # Cost-analyze a SMALL-batch lowering and scale linearly: compiling
         # a second full-batch executable just for analysis can OOM next to
         # the resident one (conv flops are linear in batch; the optimizer
-        # tail is batch-free and negligible at ResNet-50 scale).
+        # tail is batch-free and negligible at ResNet-50 scale). Resolved
+        # through the shared hlo_analysis.program_cost helper so the
+        # grasp2vec_mfu numerator is the SAME cost model as the headline.
+        from tensor2robot_tpu.parallel import hlo_analysis
         small = max(2, batch_size // 4)
         feats8 = jax.tree_util.tree_map(lambda x: x[:small],
                                         batch['features'])
         labels8 = jax.tree_util.tree_map(lambda x: x[:small],
                                          batch['labels'])
-        cost = step_fn.lower(state, feats8, labels8,
-                             rng).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-          cost = cost[0]
+        # step_fn is the trainer's python wrapper (no .lower); the jitted
+        # callable underneath takes the 5-arg reliability signature.
+        cost = hlo_analysis.program_cost(
+            trainer._train_step_jitted.lower(
+                state, feats8, labels8, rng, np.asarray(False)).compile())
         flops = float(cost.get('flops', 0.0)) * batch_size / small
         jax.clear_caches()  # drop the analysis executable before timing
       except Exception:  # noqa: BLE001
@@ -1914,12 +1929,13 @@ def main():
   on_tpu = jax.default_backend() != 'cpu'
   mesh = parallel.create_mesh()
 
-  model, (batch_size, dt, flops_per_step, n_steps,
+  model, (batch_size, dt, step_cost, n_steps,
           dt_synced) = _bench_qtopt(mesh, on_tpu)
   examples_per_sec = batch_size * n_steps / dt
   n_chips = jax.device_count()
   per_chip = examples_per_sec / n_chips
   peak = _peak_flops(jax.devices()[0])
+  flops_per_step = float(step_cost.get('flops', 0.0))
   mfu = (flops_per_step * (n_steps / dt) / (peak * n_chips)
          if peak and flops_per_step else 0.0)
 
@@ -1957,14 +1973,15 @@ def main():
   try:
     if winner is not None and (winner.compiler_options
                                or winner.model_overrides):
-      _, (t_bs, t_dt, t_flops, t_n, t_dts) = _bench_qtopt(mesh, on_tpu,
-                                                          tuned=winner)
+      _, (t_bs, t_dt, t_cost, t_n, t_dts) = _bench_qtopt(mesh, on_tpu,
+                                                         tuned=winner)
       tuned_per_chip = t_bs * t_n / t_dt / n_chips
       out['tuned_samples_per_sec_per_chip'] = round(tuned_per_chip, 2)
       if tuned_per_chip > per_chip:
         per_chip = tuned_per_chip
         examples_per_sec = t_bs * t_n / t_dt
-        batch_size, dt, n_steps, flops_per_step = t_bs, t_dt, t_n, t_flops
+        batch_size, dt, n_steps, step_cost = t_bs, t_dt, t_n, t_cost
+        flops_per_step = float(step_cost.get('flops', 0.0))
         mfu = (flops_per_step * (n_steps / dt) / (peak * n_chips)
                if peak and flops_per_step else 0.0)
         # Every headline-derived field moves with the new headline — the
@@ -1982,6 +1999,49 @@ def main():
             tuned_config=winner.config_id)
   except Exception as e:  # noqa: BLE001
     out['tuning_remeasure_error'] = repr(e)[:200]
+
+  # Roofline fields (ISSUE 19): same cost model, same peaks table, same
+  # bound-classification as the live perf/mfu gauges and the forensics
+  # roofline record — a bench JSON and a capture disagree only if the
+  # measurement disagrees, never the accounting. On hosts with no peaks
+  # entry (CPU) this honestly degrades to intensity-only; every key is
+  # still published (-1.0/'' sentinels) and self-checked like the e2e
+  # section so a schema break is loud in the JSON.
+  try:
+    from tensor2robot_tpu.observability import roofline
+    hbm_bytes = float(step_cost.get('bytes', 0.0))
+    out['hbm_bytes_per_step'] = hbm_bytes if hbm_bytes > 0 else -1.0
+    out['arithmetic_intensity'] = (
+        round(flops_per_step / hbm_bytes, 4)
+        if flops_per_step > 0 and hbm_bytes > 0 else -1.0)
+    out['flops_source'] = str(step_cost.get('source', 'unavailable'))
+    peaks = roofline.device_peaks(out['device_kind'])
+    if peaks:
+      peak_flops, peak_bw = peaks
+      ridge = roofline.ridge_intensity(peak_flops, peak_bw)
+      out['roofline_mode'] = 'roofline'
+      out['roofline_ridge_intensity'] = round(ridge, 4)
+      intensity = (flops_per_step / hbm_bytes
+                   if flops_per_step > 0 and hbm_bytes > 0 else None)
+      out['roofline_bound'] = roofline.classify_bound(intensity,
+                                                      ridge) or ''
+      step_s = dt / n_steps
+      out['hbm_bw_util'] = (round(hbm_bytes / step_s / (peak_bw * n_chips),
+                                  4)
+                            if hbm_bytes > 0 and step_s > 0 else -1.0)
+    else:
+      out['roofline_mode'] = 'intensity-only'
+      out['roofline_ridge_intensity'] = -1.0
+      out['roofline_bound'] = ''
+      out['hbm_bw_util'] = -1.0
+    out['roofline_gating_family'] = str(
+        step_cost.get('gating_family') or '')
+    missing = [key for key in roofline.ROOFLINE_BENCH_KEYS
+               if key not in out]
+    if missing:
+      out['roofline_schema_missing'] = missing
+  except Exception as e:  # noqa: BLE001 — never lose the headline metric
+    out['roofline_error'] = repr(e)[:200]
 
   # Host input pipeline: native loader rates + scaling curve + e2e.
   import shutil

@@ -60,6 +60,21 @@ fleet:
   * `fleet_sim.py` — the jax-free simulated-host writer fleet tests,
     ``bin/check_fleet_doctor``, and the MULTICHIP fleet phase share.
 
+Roofline observatory (ISSUE 19) turns the measured-ms tables into
+bound-class evidence and makes MFU a live signal:
+
+  * `roofline.py` — the per-``device_kind`` peaks table, the
+    ``t2r.roofline.v1`` record builder (measured op-family ms joined
+    with the `parallel/hlo_analysis` per-op FLOPs/bytes cost model:
+    arithmetic intensity, compute/memory/ragged bound class, % peak,
+    fusion headroom; CPU degrades to intensity-only), and the
+    ``perf/mfu`` / ``perf/hbm_bw_util`` gauges the trainer publishes
+    every log window from the SAME shared cost helper bench.py uses.
+    The watchdog's ``mfu_regression`` kind and doctor's roofline
+    verdict (naming the gating memory-bound family) read them; the
+    kernel microbench rig that consumes the ranking lives in
+    `tuning/kernelbench.py` + ``bin/t2r_kernelbench``.
+
 Metric name catalog, forensics report schema, and goodput definitions:
 docs/observability.md.
 """
@@ -93,6 +108,16 @@ from tensor2robot_tpu.observability.pipeline_xray import (
     StageMeter,
     XrayConfig,
     attribute_stages,
+)
+from tensor2robot_tpu.observability.roofline import (
+    HBM_BW_GAUGE,
+    MFU_GAUGE,
+    ROOFLINE_BENCH_KEYS,
+    ROOFLINE_SCHEMA,
+    build_record as build_roofline_record,
+    classify_bound,
+    device_peaks,
+    publish_perf_gauges,
 )
 from tensor2robot_tpu.observability.signals import (
     host_identity,
@@ -146,8 +171,12 @@ __all__ = [
     'Gauge',
     'GOODPUT_CATEGORIES',
     'GoodputTracker',
+    'HBM_BW_GAUGE',
     'HEARTBEAT_FILENAME',
     'Histogram',
+    'MFU_GAUGE',
+    'ROOFLINE_BENCH_KEYS',
+    'ROOFLINE_SCHEMA',
     'PIPELINE_RECORD_SCHEMA',
     'PipelineXray',
     'RECOVERY_SCHEMA',
@@ -163,12 +192,16 @@ __all__ = [
     'attribute_goodput',
     'attribute_stages',
     'build_report',
+    'build_roofline_record',
+    'classify_bound',
+    'device_peaks',
     'discover_hosts',
     'exponential_buckets',
     'fleet_summary',
     'get_registry',
     'host_identity',
     'install_jax_listeners',
+    'publish_perf_gauges',
     'read_fleet',
     'read_heartbeat',
     'read_reports',

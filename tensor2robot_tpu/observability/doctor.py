@@ -16,7 +16,10 @@ Evidence consumed, in rough severity order:
   * recompile + shape-signature gauges (the device_feed invariant);
   * device/host memory gauge trends across train records;
   * ``anomaly`` records the in-process watchdog wrote;
-  * the newest forensics report's top op + occupancy.
+  * the newest forensics report's top op + occupancy;
+  * the newest roofline attribution (report or ``roofline`` record):
+    under the MFU floor the verdict names the gating memory-bound op
+    family and its fusion headroom (CRITICAL on a live run).
 
 ``diagnose`` returns ``Finding`` dicts ranked most-severe-first; the CLI
 prints them and exits non-zero only on CRITICAL findings so the command
@@ -47,6 +50,11 @@ _SEVERITY_RANK = {CRITICAL: 0, WARNING: 1, INFO: 2, OK: 3}
 
 # Goodput losses below this fraction are not worth a finding.
 _GOODPUT_FLOOR = 0.10
+
+# MFU below this on a device with a peaks entry earns a roofline
+# verdict naming the gating memory-bound family (BENCH_r05's device
+# headline sits at 36.5%; a healthy run should not be under 25%).
+_MFU_FLOOR = 0.25
 
 
 def _finding(severity: str, message: str, **detail) -> Dict[str, object]:
@@ -850,6 +858,76 @@ def diagnose(model_dir: str,
     for warning in report.get('warnings') or []:
       findings.append(_finding(INFO, 'forensics@{}: {}'.format(
           step, warning)))
+
+  # Roofline verdict: the newest t2r.roofline.v1 evidence — the latest
+  # capture report's attribution, else the compact telemetry record the
+  # trainer logs alongside it. Under the MFU floor with a memory-bound
+  # family in the table, the verdict NAMES that family: it is the op
+  # the kernel work (ROADMAP item 1) should fuse first, and its
+  # headroom is the predicted win.
+  roofline = None
+  roofline_step = None
+  if reports and reports[-1][1].get('roofline'):
+    roofline_step = reports[-1][0]
+    roofline = reports[-1][1]['roofline']
+  else:
+    roofline_records = [r for r in records if r.get('kind') == 'roofline']
+    if roofline_records:
+      roofline = roofline_records[-1]
+      roofline_step = roofline.get('step')
+  if roofline:
+    mfu_value = roofline.get('mfu')
+    gating = roofline.get('gating_memory_bound_family')
+    headroom_ms = None
+    for row in roofline.get('families') or []:
+      if row.get('family') == gating:
+        headroom_ms = row.get('headroom_ms')
+        break
+    if roofline.get('mode') == 'intensity-only':
+      families = roofline.get('families') or []
+      top_family = families[0].get('family') if families else None
+      findings.append(_finding(
+          INFO, 'roofline@{}: intensity-only mode — device kind {!r} has '
+          'no peaks entry (CPU or unknown), so %-peak/MFU/headroom are '
+          'withheld; program intensity {} flops/byte{}'.format(
+              roofline_step, roofline.get('device_kind'),
+              roofline.get('arithmetic_intensity'),
+              ', top measured family {}'.format(top_family)
+              if top_family else ''),
+          kind='roofline', mode='intensity-only',
+          arithmetic_intensity=roofline.get('arithmetic_intensity')))
+    elif mfu_value is not None and mfu_value < _MFU_FLOOR:
+      if gating:
+        findings.append(_finding(
+            WARNING if run_ended else CRITICAL,
+            'roofline@{}: MFU {:.1%} is under the {:.0%} floor and the '
+            'gating memory-bound family is {}{} — a fused kernel for it '
+            'is the predicted win'.format(
+                roofline_step, mfu_value, _MFU_FLOOR, gating,
+                ' (headroom {:.2f} ms/step)'.format(headroom_ms)
+                if headroom_ms is not None else ''),
+            kind='roofline', mfu=mfu_value,
+            gating_memory_bound_family=gating, headroom_ms=headroom_ms))
+      else:
+        findings.append(_finding(
+            WARNING,
+            'roofline@{}: MFU {:.1%} is under the {:.0%} floor but no '
+            'memory-bound family stands out — compute-bound or '
+            'unattributed; inspect the capture'.format(
+                roofline_step, mfu_value, _MFU_FLOOR),
+            kind='roofline', mfu=mfu_value))
+    else:
+      findings.append(_finding(
+          INFO, 'roofline@{}: MFU {}, HBM bandwidth {}, '
+          'bound profile healthy{}'.format(
+              roofline_step,
+              '{:.1%}'.format(mfu_value) if mfu_value is not None
+              else 'n/a',
+              '{:.1%}'.format(roofline['hbm_bw_util'])
+              if roofline.get('hbm_bw_util') is not None else 'n/a',
+              ' (watch {})'.format(gating) if gating else ''),
+          kind='roofline', mfu=mfu_value,
+          gating_memory_bound_family=gating))
 
   if not any(f['severity'] in (CRITICAL, WARNING) for f in findings):
     findings.append(_finding(

@@ -321,6 +321,7 @@ def build_report(step: int,
       'memory': {},
       'tuned_config': tuned_config,
       'pipeline': dict(pipeline) if pipeline else None,
+      'roofline': None,
       'warnings': warnings,
   }
   try:
@@ -343,6 +344,7 @@ def build_report(step: int,
       warnings.append('xplane analysis failed ({}: {}); raw capture kept '
                       'at {}'.format(type(e).__name__, e, xplane_path))
   hlo_collectives = None
+  hlo_text = None
   if hlo_text_fn is not None:
     try:
       hlo_text = hlo_text_fn()
@@ -355,6 +357,30 @@ def build_report(step: int,
         hlo_collectives = hlo_analysis.collective_ops(hlo_text)
     except Exception as e:  # noqa: BLE001 — HLO is best-effort evidence
       warnings.append('collective analysis failed: {}'.format(e))
+  if hlo_text:
+    # Roofline attribution (t2r.roofline.v1): join the capture's
+    # measured op-family ms with the per-family FLOPs/bytes cost table
+    # parsed from the same program's post-opt HLO. Works even when the
+    # capture produced no families (record carries costs, all
+    # unattributed) — the step's intensity profile is evidence either
+    # way. MFU/bandwidth headlines come from the live gauges the
+    # trainer publishes from the SAME shared cost model.
+    try:
+      from tensor2robot_tpu.observability import roofline as roofline_lib
+      from tensor2robot_tpu.parallel import hlo_analysis
+      record = roofline_lib.build_record(
+          families,
+          hlo_analysis.op_cost_table(hlo_text),
+          str((host or {}).get('device_kind', 'unknown')),
+          step=int(step),
+          cost_source='hlo_parse')
+      for key, gauge in (('mfu', roofline_lib.MFU_GAUGE),
+                         ('hbm_bw_util', roofline_lib.HBM_BW_GAUGE)):
+        if record.get(key) is None and scalars.get(gauge):
+          record[key] = scalars[gauge]
+      report['roofline'] = record
+    except Exception as e:  # noqa: BLE001 — attribution is best-effort
+      warnings.append('roofline attribution failed: {}'.format(e))
   if families:
     try:
       report['collective_wait'] = split_collective_wait(

@@ -114,16 +114,20 @@ def host_identity() -> Dict[str, object]:
   """This process's fleet identity: the ``host_meta`` dict every
   per-host telemetry record is stamped with (ISSUE 9).
 
-  ``{'process_index', 'process_count', 'device_kind', 'hostname'}`` —
-  process coordinates from ``jax.distributed``'s view of the world,
-  device kind from the first local device. Degrades to the
-  single-process identity (``0 of 1``, ``device_kind='unknown'``) on
-  jax-free hosts so the doctor/fleet tooling can call it too.
+  ``{'process_index', 'process_count', 'device_kind', 'device_count',
+  'hostname'}`` — process coordinates from ``jax.distributed``'s view
+  of the world, device kind + local chip count from the local device
+  list (the roofline/MFU consumers need BOTH: per-device program flops
+  are per-chip, the peaks table is per-``device_kind``). Degrades to
+  the single-process identity (``0 of 1``, ``device_kind='unknown'``,
+  ``device_count=0``) on jax-free hosts so the doctor/fleet tooling can
+  call it too.
   """
   identity: Dict[str, object] = {
       'process_index': 0,
       'process_count': 1,
       'device_kind': 'unknown',
+      'device_count': 0,
       'hostname': socket.gethostname(),
   }
   try:
@@ -132,6 +136,7 @@ def host_identity() -> Dict[str, object]:
     identity['process_index'] = int(jax.process_index())
     identity['process_count'] = int(jax.process_count())
     local = jax.local_devices()
+    identity['device_count'] = len(local)
     if local:
       identity['device_kind'] = str(
           getattr(local[0], 'device_kind', 'unknown'))

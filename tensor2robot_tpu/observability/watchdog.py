@@ -23,6 +23,13 @@ as monitored failures, not graphs someone may eyeball later. Detections
     more than ``hbm_growth_bytes`` total: the leak signature (a stable
     training step reuses buffers; a watermark that climbs every window
     is retained state, not noise).
+  * ``mfu_regression`` — the live ``perf/mfu`` gauge (published by the
+    trainer every log window from the shared cost model,
+    observability/roofline.py) fell below ``mfu_regression_ratio`` x
+    its rolling-median healthy baseline. Same healthy-windows-only
+    folding as step time — a sustained utilization collapse keeps
+    firing. Hosts with no peaks entry (CPU) never publish the gauge, so
+    the check is trivially quiet there instead of noisily wrong.
   * ``heartbeat_stale`` — out-of-process only (``check_heartbeat``):
     the heartbeat file's age exceeds ``heartbeat_stale_secs``. In-process
     the trainer loop IS the heartbeat writer, so staleness is checked by
@@ -51,11 +58,13 @@ import statistics
 from typing import Deque, Dict, List, Optional
 
 from tensor2robot_tpu.observability import registry as registry_lib
+# Writer of the MFU gauge this watchdog reads (stdlib-only import).
+from tensor2robot_tpu.observability.roofline import MFU_GAUGE
 
 __all__ = ['Anomaly', 'Watchdog', 'WatchdogConfig',
            'ANOMALY_COUNTER', 'RECOMPILE_GAUGE', 'FEED_SHAPES_GAUGE',
-           'DEVICE_BYTES_GAUGE', 'STRAGGLER', 'HOST_DEAD',
-           'check_heartbeat']
+           'DEVICE_BYTES_GAUGE', 'MFU_GAUGE', 'MFU_REGRESSION',
+           'STRAGGLER', 'HOST_DEAD', 'check_heartbeat']
 
 # Metric names this watchdog reads (writers: trainer + data/device_feed +
 # observability/signals.py) and writes (the anomaly counter family).
@@ -68,6 +77,7 @@ STEP_TIME_REGRESSION = 'step_time_regression'
 GOODPUT_DROP = 'goodput_drop'
 RECOMPILE = 'recompile'
 HBM_GROWTH = 'hbm_growth'
+MFU_REGRESSION = 'mfu_regression'
 HEARTBEAT_STALE = 'heartbeat_stale'
 # Fleet kinds, detected by observability/fleet.py (FleetWatchdog):
 STRAGGLER = 'straggler'
@@ -109,13 +119,17 @@ class WatchdogConfig:
                hbm_growth_windows: int = 4,
                hbm_growth_bytes: float = 64 * 2**20,
                recompile_warmup_windows: int = 1,
-               heartbeat_stale_secs: float = 300.0):
+               heartbeat_stale_secs: float = 300.0,
+               mfu_regression_ratio: float = 0.75):
     if regression_ratio <= 1.0:
       raise ValueError('regression_ratio must exceed 1.0; got {}.'.format(
           regression_ratio))
     if not 0.0 < goodput_drop < 1.0:
       raise ValueError('goodput_drop must be a fraction in (0, 1); got {}.'
                        .format(goodput_drop))
+    if not 0.0 < mfu_regression_ratio < 1.0:
+      raise ValueError('mfu_regression_ratio must be a fraction in (0, 1); '
+                       'got {}.'.format(mfu_regression_ratio))
     self.regression_ratio = float(regression_ratio)
     self.min_baseline_windows = int(min_baseline_windows)
     self.baseline_windows = int(baseline_windows)
@@ -124,6 +138,7 @@ class WatchdogConfig:
     self.hbm_growth_bytes = float(hbm_growth_bytes)
     self.recompile_warmup_windows = int(recompile_warmup_windows)
     self.heartbeat_stale_secs = float(heartbeat_stale_secs)
+    self.mfu_regression_ratio = float(mfu_regression_ratio)
 
 
 class Watchdog:
@@ -138,6 +153,8 @@ class Watchdog:
     self._productive: Deque[float] = collections.deque(
         maxlen=self.config.baseline_windows)
     self._last_goodput_seconds: Optional[Dict[str, float]] = None
+    self._mfu: Deque[float] = collections.deque(
+        maxlen=self.config.baseline_windows)
     self._windows_seen = 0
     self._recompile_baseline: Optional[float] = None
     self._shapes_reported = 1.0  # highest signature count already reported
@@ -169,6 +186,7 @@ class Watchdog:
       anomalies.extend(self._observe_goodput(step, dict(goodput_seconds)))
     anomalies.extend(self._observe_recompiles(step))
     anomalies.extend(self._observe_hbm(step))
+    anomalies.extend(self._observe_mfu(step))
     if anomalies:
       family = self.registry.counter_family(ANOMALY_COUNTER, ('kind',))
       for anomaly in anomalies:
@@ -288,6 +306,30 @@ class Watchdog:
         self._hbm_streak[device] = 0
         self._hbm_streak_bytes[device] = 0.0
     return anomalies
+
+  def _observe_mfu(self, step: int) -> List[Anomaly]:
+    # Published by the trainer from the shared cost model only on hosts
+    # with a device-peaks entry; <= 0 means "not applicable", not "0%
+    # utilized" — skip, never baseline it.
+    value = self.registry.gauge(MFU_GAUGE).value
+    if value <= 0.0:
+      return []
+    baseline = (statistics.median(self._mfu)
+                if len(self._mfu) >= self.config.min_baseline_windows
+                else None)
+    if baseline is not None and baseline > 0.0 and \
+        value < self.config.mfu_regression_ratio * baseline:
+      return [Anomaly(
+          MFU_REGRESSION, step,
+          'MFU {:.1%} fell below {:.0%} of the rolling baseline {:.1%}: '
+          'the device step is doing the same flops slower'.format(
+              value, self.config.mfu_regression_ratio, baseline),
+          {'mfu': value, 'baseline_mfu': baseline,
+           'ratio': value / baseline})]
+    # Healthy window: fold in (anomalous ones stay out, same rationale
+    # as step time).
+    self._mfu.append(value)
+    return []
 
   # -- out-of-process detections ---------------------------------------------
 

@@ -19,6 +19,7 @@ and the model_fn skeleton it drives (/root/reference/models/abstract_model.py
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -45,6 +46,7 @@ from tensor2robot_tpu.observability import (
 from tensor2robot_tpu.observability import fleet as fleet_lib
 from tensor2robot_tpu.observability import goodput as goodput_lib
 from tensor2robot_tpu.observability import pipeline_xray as xray_lib
+from tensor2robot_tpu.observability import roofline as roofline_lib
 from tensor2robot_tpu.observability import signals as signals_lib
 from tensor2robot_tpu.observability import watchdog as watchdog_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -304,6 +306,7 @@ class Trainer:
     self._feed_depth = max(1, int(feed_depth))
     self._train_step_compiled = None  # AOT executable under tuned options
     self._train_step_artifact = None  # CompiledArtifact (provenance+HLO)
+    self._step_cost_cache = None  # cost-model totals (False = resolved none)
     self.active_config_id: Optional[str] = None
 
   def _put_batch(self, batch: dict, channel: str = 'train'):
@@ -440,6 +443,56 @@ class Trainer:
     except Exception:  # noqa: BLE001 — private probe; absent on old jax
       return
     registry.gauge(watchdog_lib.RECOMPILE_GAUGE).set(float(size))
+
+  def _step_cost(self) -> Optional[Dict[str, object]]:
+    """Per-device train-step FLOPs/bytes through THE shared cost model
+    (parallel/hlo_analysis.program_cost) — the same helper bench.py's
+    flops_per_step resolves through, so the live ``perf/mfu`` gauge and
+    the bench headline agree by construction. Resolution order mirrors
+    ``_train_step_hlo``: persisted artifact HLO, then the live tuned
+    executable, then a one-off relower from the recorded abstract args.
+    Resolved once and cached (False = resolved to nothing)."""
+    if self._step_cost_cache is not None:
+      return self._step_cost_cache or None
+    cost = None
+    try:
+      from tensor2robot_tpu.parallel import hlo_analysis
+      if self._train_step_artifact is not None and \
+          self._train_step_artifact.hlo_text:
+        cost = hlo_analysis.program_cost(self._train_step_artifact.hlo_text)
+      elif self._train_step_compiled is not None:
+        cost = hlo_analysis.program_cost(self._train_step_compiled)
+      elif self._train_step_jitted is not None and \
+          self._step_abstract is not None:
+        cost = hlo_analysis.program_cost(
+            self._train_step_jitted.lower(*self._step_abstract).compile())
+    except Exception:  # noqa: BLE001 — perf accounting must never kill a run
+      cost = None
+    self._step_cost_cache = cost if cost and cost.get('flops') else False
+    return self._step_cost_cache or None
+
+  def _publish_perf(self, registry, step_time_s: float) -> None:
+    """``perf/mfu`` + ``perf/hbm_bw_util`` for this log window.
+
+    Only on hosts whose ``device_kind`` has a peaks-table entry — CPU
+    (and unknown kinds) publish nothing rather than a fabricated 0, so
+    the watchdog's ``mfu_regression`` check is trivially quiet there
+    and CPU test runs pay no relower cost (the step cost is only
+    resolved once a peaks entry exists)."""
+    if step_time_s <= 0.0:
+      return
+    kind = str(self.host_identity.get('device_kind', 'unknown'))
+    if roofline_lib.device_peaks(kind) is None:
+      return
+    cost = self._step_cost()
+    if cost is None:
+      return
+    try:
+      roofline_lib.publish_perf_gauges(
+          registry, float(cost['flops']), float(cost['bytes']),
+          step_time_s, kind)
+    except Exception:  # noqa: BLE001
+      pass
 
   # -- state ---------------------------------------------------------------
 
@@ -956,6 +1009,18 @@ class Trainer:
             report_path = self._auto_profiler.maybe_profile(step_i)
             if report_path is not None and telemetry is not None:
               telemetry.log('forensics', step=step_i, report=report_path)
+              # The capture's roofline attribution also rides the jsonl
+              # stream (compact t2r.roofline.v1 payload) so summarize/
+              # tail/doctor see it without opening report files.
+              try:
+                with open(report_path, encoding='utf-8') as f:
+                  roofline_record = json.load(f).get('roofline')
+              except Exception:  # noqa: BLE001 — report is best-effort
+                roofline_record = None
+              if roofline_record:
+                telemetry.log(
+                    'roofline', step=step_i,
+                    **roofline_lib.telemetry_payload(roofline_record))
               telemetry.flush()
             with span('data.put_batch') as sp:
               if pipelined is not None:
@@ -1044,6 +1109,10 @@ class Trainer:
               # this very TensorBoard write and telemetry record.
               signals_lib.sample_memory(registry)
               self._sample_recompiles(registry)
+              # Live MFU ledger: gauges land BEFORE the watchdog pass so
+              # mfu_regression sees this very window's utilization, and
+              # before the exports so TensorBoard + telemetry carry it.
+              self._publish_perf(registry, step_time_s)
               pipeline_record = None
               if self._xray is not None:
                 # X-ray before watchdog: a data-path incident should

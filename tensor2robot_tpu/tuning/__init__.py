@@ -22,6 +22,13 @@ compiler-level tuning pjit-era TPU stacks report as decisive
 load cache entries at startup and apply them to the train-step compile;
 forensics reports carry the active config id so a regression is
 attributable to the config that produced it.
+
+``kernelbench`` (ISSUE 19) turns the same chained timing harness on
+individual kernels: registered candidates (``layers/pallas_wgrad`` is
+the first) vs their fused-XLA baselines, publishing schema-locked
+``KERNEL_BENCH_KEYS`` rows appended to ``kernelbench.json`` next to the
+tuning cache (``bin/t2r_kernelbench``) — the rig ROADMAP item 1's
+kernel work lands numbers against.
 """
 
 from tensor2robot_tpu.tuning.autotuner import (
@@ -30,6 +37,14 @@ from tensor2robot_tpu.tuning.autotuner import (
     measure_chained,
     sweep,
 )
+from tensor2robot_tpu.tuning.kernelbench import (
+    KERNEL_BENCH_KEYS,
+    KERNEL_BENCH_SCHEMA,
+    default_results_path,
+    read_results,
+    register,
+)
+from tensor2robot_tpu.tuning.kernelbench import run as run_kernelbench
 from tensor2robot_tpu.tuning.cache import (
     ConfigCache,
     abstract_signature,
@@ -45,11 +60,17 @@ __all__ = [
     'CandidateResult',
     'CompileConfig',
     'ConfigCache',
+    'KERNEL_BENCH_KEYS',
+    'KERNEL_BENCH_SCHEMA',
     'SweepResult',
     'abstract_signature',
     'cache_key',
     'candidate_configs',
     'default_cache_path',
+    'default_results_path',
     'measure_chained',
+    'read_results',
+    'register',
+    'run_kernelbench',
     'sweep',
 ]
